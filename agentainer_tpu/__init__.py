@@ -1,0 +1,20 @@
+"""agentainer_tpu — a TPU-native runtime for self-hosted LLM agents.
+
+A brand-new framework with the capabilities of Agentainer-lab (reference:
+/root/reference, a Go control plane that runs agents as Docker containers and
+proxies HTTP to them), re-designed TPU-first:
+
+- agents are model-serving programs placed on TPU chips by a slice scheduler
+  (replacing the Docker-socket backend, reference pkg/docker + internal/agent),
+- the inference path is an in-process JAX/XLA prefill+decode engine with
+  continuous batching (replacing the external OpenAI/Gemini HTTP calls of
+  reference examples/*-agent),
+- the durable request journal drains into the batching scheduler
+  (reference internal/requests journaled into Redis and re-POSTed via proxy),
+- crash recovery restores conversation + KV-cache state from the store
+  (reference restores only container infra state, docs/RESILIENT_AGENTS.md),
+- models shard over an ICI device mesh via jax.sharding / shard_map
+  (TP / DP / SP-ring-attention / EP), not NCCL.
+"""
+
+__version__ = "0.1.0"

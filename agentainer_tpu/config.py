@@ -1,0 +1,111 @@
+"""Layered daemon configuration.
+
+Parity with the reference's viper config (internal/config/config.go:49-107):
+YAML file searched in ``.``, ``~/.agentainer_tpu``, ``/etc/agentainer_tpu``;
+environment overrides with an ``ATPU_`` prefix; defaults matching the
+reference's envelope (server on :8081, static bearer token, request
+persistence on). TPU additions: store URL (mem:// by default — no Redis
+sidecar needed on a TPU-VM) and the slice topology the scheduler manages.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+
+import yaml
+
+DEFAULT_TOKEN = "agentainer-default-token"  # config.go:66 parity
+
+
+@dataclass
+class ServerConfig:
+    host: str = "0.0.0.0"
+    port: int = 8081
+
+
+@dataclass
+class SliceConfig:
+    total_chips: int = 8
+    hbm_per_chip: int = 16 * 1024**3
+    name: str = "v5e-8"
+
+
+@dataclass
+class FeatureFlags:
+    request_persistence: bool = True  # config.go:70
+    auto_restart_default: bool = False
+
+
+@dataclass
+class Cadences:
+    """Background-loop intervals, reference values (BASELINE.md)."""
+
+    state_sync_s: float = 10.0  # main.go:325
+    replay_scan_s: float = 5.0  # replay_worker.go:37
+    health_interval_s: float = 30.0  # monitor.go:119
+    metrics_interval_s: float = 10.0  # collector.go:205
+
+
+@dataclass
+class Config:
+    server: ServerConfig = field(default_factory=ServerConfig)
+    slice: SliceConfig = field(default_factory=SliceConfig)
+    features: FeatureFlags = field(default_factory=FeatureFlags)
+    cadences: Cadences = field(default_factory=Cadences)
+    auth_token: str = DEFAULT_TOKEN
+    store_url: str = "mem://"
+    data_dir: str = "~/.agentainer_tpu"
+
+    @property
+    def data_path(self) -> Path:
+        return Path(os.path.expanduser(self.data_dir))
+
+
+_SEARCH_PATHS = [".", "~/.agentainer_tpu", "/etc/agentainer_tpu"]
+
+
+def load_config(path: str | None = None) -> Config:
+    cfg = Config()
+    doc: dict = {}
+    candidates = [path] if path else [os.path.join(os.path.expanduser(p), "config.yaml") for p in _SEARCH_PATHS]
+    for cand in candidates:
+        if cand and os.path.isfile(cand):
+            with open(cand) as f:
+                doc = yaml.safe_load(f) or {}
+            break
+
+    server = doc.get("server", {})
+    cfg.server.host = server.get("host", cfg.server.host)
+    cfg.server.port = int(server.get("port", cfg.server.port))
+    sl = doc.get("slice", {})
+    cfg.slice.total_chips = int(sl.get("total_chips", cfg.slice.total_chips))
+    cfg.slice.hbm_per_chip = int(sl.get("hbm_per_chip", cfg.slice.hbm_per_chip))
+    cfg.slice.name = sl.get("name", cfg.slice.name)
+    feats = doc.get("features", {})
+    cfg.features.request_persistence = bool(
+        feats.get("request_persistence", cfg.features.request_persistence)
+    )
+    sec = doc.get("security", {})
+    cfg.auth_token = sec.get("auth_token", cfg.auth_token)
+    cfg.store_url = doc.get("store", {}).get("url", cfg.store_url)
+    cfg.data_dir = doc.get("data_dir", cfg.data_dir)
+
+    # Env overrides, explicit binds like the reference's AGENTAINER_* set
+    # (config.go:72-81).
+    env = os.environ
+    cfg.server.host = env.get("ATPU_SERVER_HOST", cfg.server.host)
+    cfg.server.port = int(env.get("ATPU_SERVER_PORT", cfg.server.port))
+    cfg.auth_token = env.get("ATPU_AUTH_TOKEN", cfg.auth_token)
+    cfg.store_url = env.get("ATPU_STORE_URL", cfg.store_url)
+    cfg.data_dir = env.get("ATPU_DATA_DIR", cfg.data_dir)
+    if "ATPU_SLICE_CHIPS" in env:
+        cfg.slice.total_chips = int(env["ATPU_SLICE_CHIPS"])
+    if "ATPU_REQUEST_PERSISTENCE" in env:
+        cfg.features.request_persistence = env["ATPU_REQUEST_PERSISTENCE"].lower() in (
+            "1",
+            "true",
+            "yes",
+        )
+    return cfg

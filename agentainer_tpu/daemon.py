@@ -1,0 +1,158 @@
+"""Daemon wiring — the composition root.
+
+The analogue of the reference's ``runServer`` (cmd/agentainer/main.go:284-356):
+construct infra adapters (store, backend, scheduler), services (manager,
+journal, health, metrics, reconciler, backups, log plane), the API server,
+and the background loops (state sync at 10s, replay at 5s, metrics at 10s,
+health per-agent), then serve until stopped.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable
+
+from aiohttp import web
+
+from .config import Config, load_config
+from .manager.agents import AgentManager
+from .manager.audit import LogPlane
+from .manager.backup import BackupManager
+from .manager.health import HealthMonitor
+from .manager.journal import RequestJournal
+from .manager.metrics import MetricsPlane
+from .manager.reconcile import QuickSync, StateSynchronizer
+from .manager.replay import ReplayWorker
+from .runtime.backend import Backend
+from .runtime.scheduler import SliceScheduler, SliceTopology
+from .store import Store, open_store
+
+
+@dataclass
+class Services:
+    config: Config
+    store: Store
+    backend: Backend
+    scheduler: SliceScheduler
+    manager: AgentManager
+    journal: RequestJournal
+    logs: LogPlane
+    metrics: MetricsPlane
+    backups: BackupManager
+    health: HealthMonitor = None  # type: ignore[assignment]
+    quick_sync: QuickSync = None  # type: ignore[assignment]
+    state_sync: StateSynchronizer = None  # type: ignore[assignment]
+    replay: ReplayWorker = None  # type: ignore[assignment]
+    dispatch: Callable[..., Awaitable[tuple[int, dict, bytes]]] = None  # type: ignore[assignment]
+    _background_started: bool = field(default=False, repr=False)
+
+
+def build_services(
+    config: Config | None = None,
+    store: Store | None = None,
+    backend: Backend | None = None,
+    console_logs: bool = True,
+    data_dir: str | None = None,
+) -> Services:
+    config = config or load_config()
+    store = store or open_store(config.store_url)
+    if backend is None:
+        from .runtime.local import LocalBackend
+
+        backend = LocalBackend(store=store)
+    elif getattr(backend, "store", "absent") is None:
+        backend.store = store  # LocalBackend built without a store: inject ours
+    topo = SliceTopology(
+        total_chips=config.slice.total_chips,
+        hbm_per_chip=config.slice.hbm_per_chip,
+        name=config.slice.name,
+    )
+    scheduler = SliceScheduler(store, topo)
+    manager = AgentManager(store, backend, scheduler)
+    journal = RequestJournal(store)
+    ddir = data_dir if data_dir is not None else config.data_path
+    logs = LogPlane(store, data_dir=ddir, console=console_logs)
+    metrics = MetricsPlane(manager, store, interval_s=config.cadences.metrics_interval_s)
+    backups = BackupManager(manager, store, ddir)
+
+    services = Services(
+        config=config,
+        store=store,
+        backend=backend,
+        scheduler=scheduler,
+        manager=manager,
+        journal=journal,
+        logs=logs,
+        metrics=metrics,
+        backups=backups,
+    )
+
+    quick_sync = QuickSync(manager, backend)
+    manager.set_quick_sync(quick_sync)
+    services.quick_sync = quick_sync
+    services.state_sync = StateSynchronizer(
+        quick_sync, backend, interval_s=config.cadences.state_sync_s
+    )
+
+    # The app's dispatch function is the single choke point for traffic into
+    # engines; replay and health reuse it (set in create_app).
+    from .server.app import ControlPlaneApp
+
+    app_obj = ControlPlaneApp(services)
+    services.dispatch = app_obj.dispatch_to_agent
+    services.app = app_obj.app  # type: ignore[attr-defined]
+
+    services.health = HealthMonitor(manager, store, services.dispatch)
+    services.replay = ReplayWorker(
+        journal, manager, services.dispatch, interval_s=config.cadences.replay_scan_s
+    )
+    return services
+
+
+async def start_background(services: Services) -> None:
+    """Start the reconciler, replay worker, metrics collector, and health
+    monitor (runServer's goroutines, main.go:325-341 + server.go:124-135)."""
+    if services._background_started:
+        return
+    services._background_started = True
+    await services.state_sync.start()
+    if services.config.features.request_persistence:
+        await services.replay.start()
+    await services.metrics.start()
+    await services.health.start()
+
+
+async def stop_background(services: Services) -> None:
+    if not services._background_started:
+        return
+    services._background_started = False
+    await services.replay.stop()
+    await services.state_sync.stop()
+    await services.metrics.stop()
+    await services.health.stop()
+
+
+async def run_daemon(services: Services) -> None:
+    """Serve until cancelled (SIGINT/SIGTERM handling lives in the CLI)."""
+    runner = web.AppRunner(services.app)  # type: ignore[attr-defined]
+    await runner.setup()
+    site = web.TCPSite(runner, services.config.server.host, services.config.server.port)
+    await site.start()
+    if hasattr(services.backend, "set_control"):
+        services.backend.set_control(
+            f"http://127.0.0.1:{services.config.server.port}", services.config.auth_token
+        )
+    await start_background(services)
+    services.logs.info(
+        "daemon",
+        f"control plane listening on {services.config.server.host}:"
+        f"{services.config.server.port} (slice {services.scheduler.topology.name})",
+    )
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    finally:
+        await stop_background(services)
+        services.backend.close()
+        await runner.cleanup()

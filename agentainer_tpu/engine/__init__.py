@@ -1,0 +1,18 @@
+"""Serving engines — the programs agents run.
+
+Replaces the reference's user-supplied Docker images (Flask apps calling
+external LLM APIs, examples/gpt-agent/app.py). Engines here are in-process
+serving programs placed on TPU chips:
+
+- ``echo``  mock-LLM parity agent (engine/echo.py): same HTTP contract as
+  examples/gpt-agent (/chat /health /history /clear /metrics), conversation
+  memory in the store — BASELINE.json config #1.
+- ``llm``   JAX prefill+decode engine with continuous batching
+  (engine/llm.py) — BASELINE.json configs #2-#5.
+"""
+
+from __future__ import annotations
+
+
+def known_engines() -> set[str]:
+    return {"echo", "llm"}

@@ -1,0 +1,130 @@
+"""Mock-LLM echo engine — HTTP-contract parity with the reference example
+agents (examples/gpt-agent/app.py), minus the external LLM API.
+
+Routes (app.py:32-179): ``GET /`` info, ``GET /health``, ``POST /chat``,
+``GET /history``, ``POST /clear``, ``GET /metrics``. Conversation turns are
+persisted through the control plane's store (the reference keeps them in
+Redis at ``agent:{AGENT_ID}:conversations`` trimmed to 50, app.py:50-68) so
+history survives an engine crash — this is BASELINE.json config #1 and the
+baseline workload for the proxy/journal benchmark.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from aiohttp import web
+
+from ..runtime.store_client import StoreClient
+
+MAX_TURNS = 50  # app.py:58 trim parity
+
+
+class EchoEngine:
+    def __init__(self) -> None:
+        self.agent_id = os.environ.get("AGENTAINER_AGENT_ID", "standalone")
+        self.agent_name = os.environ.get("AGENTAINER_AGENT_NAME", self.agent_id)
+        self.store = StoreClient.from_env()
+        self.started_at = time.time()
+        self.requests_total = 0
+        self.chats_total = 0
+
+    @property
+    def convo_key(self) -> str:
+        return f"agent:{self.agent_id}:conversations"
+
+    @property
+    def metrics_key(self) -> str:
+        return f"agent:{self.agent_id}:metrics"
+
+    def app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/", self.h_root)
+        app.router.add_get("/health", self.h_health)
+        app.router.add_post("/chat", self.h_chat)
+        app.router.add_get("/history", self.h_history)
+        app.router.add_post("/clear", self.h_clear)
+        app.router.add_get("/metrics", self.h_metrics)
+        app.on_cleanup.append(lambda _app: self.store.close())
+        return app
+
+    async def h_root(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "agent": self.agent_name,
+                "engine": "echo",
+                "status": "running",
+                "endpoints": ["/health", "/chat", "/history", "/clear", "/metrics"],
+            }
+        )
+
+    async def h_health(self, request: web.Request) -> web.Response:
+        self.requests_total += 1
+        return web.json_response(
+            {"status": "healthy", "agent_id": self.agent_id, "uptime_s": time.time() - self.started_at}
+        )
+
+    async def h_chat(self, request: web.Request) -> web.Response:
+        self.requests_total += 1
+        self.chats_total += 1
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        message = str(body.get("message", ""))
+        reply = f"Echo: {message}"
+        now = time.time()
+        try:
+            await self.store.rpush(
+                self.convo_key,
+                json.dumps({"role": "user", "content": message, "ts": now}),
+                json.dumps({"role": "assistant", "content": reply, "ts": now}),
+            )
+            await self.store.ltrim(self.convo_key, -2 * MAX_TURNS, -1)
+            await self.store.hincrby(self.metrics_key, "chats", 1)
+            n = await self.store.llen(self.convo_key)
+        except Exception:
+            n = -1  # store unreachable: still serve (availability over convo durability)
+        return web.json_response(
+            {"response": reply, "agent": self.agent_name, "conversation_length": n}
+        )
+
+    async def h_history(self, request: web.Request) -> web.Response:
+        self.requests_total += 1
+        try:
+            raw = await self.store.lrange(self.convo_key, 0, -1)
+        except Exception:
+            raw = []
+        turns = []
+        for item in raw:
+            try:
+                turns.append(json.loads(item))
+            except json.JSONDecodeError:
+                continue
+        return web.json_response({"history": turns, "count": len(turns)})
+
+    async def h_clear(self, request: web.Request) -> web.Response:
+        self.requests_total += 1
+        try:
+            await self.store.delete(self.convo_key)
+        except Exception:
+            pass
+        return web.json_response({"status": "cleared"})
+
+    async def h_metrics(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "engine": "echo",
+                "requests_total": self.requests_total,
+                "chats_total": self.chats_total,
+                "uptime_s": time.time() - self.started_at,
+            }
+        )
+
+
+def serve() -> None:
+    engine = EchoEngine()
+    port = int(os.environ.get("AGENTAINER_PORT", "8000"))
+    web.run_app(engine.app(), host="127.0.0.1", port=port, print=None)

@@ -1,0 +1,217 @@
+"""LLM engine subprocess — serves a JAX prefill+decode engine over the same
+HTTP contract as the echo engine (and the reference's example agents,
+examples/gpt-agent/app.py:32-179): /chat /health /history /clear /metrics.
+
+The serving stack inside this process:
+
+    aiohttp handlers → continuous-batching scheduler (engine/llm.py)
+        → JAX model (models/llama.py | models/mixtral.py) on the chips
+          assigned by the slice scheduler (AGENTAINER_CHIPS)
+
+Conversation turns persist through the control plane's store (crash-durable);
+the KV-cache can be checkpointed there too (engine/checkpoint.py) so a
+restarted engine resumes mid-conversation — BASELINE.json config #3.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+
+from aiohttp import web
+
+from ..runtime.store_client import StoreClient
+
+MAX_TURNS = 50
+
+
+class LLMServeApp:
+    def __init__(self) -> None:
+        self.agent_id = os.environ.get("AGENTAINER_AGENT_ID", "standalone")
+        self.agent_name = os.environ.get("AGENTAINER_AGENT_NAME", self.agent_id)
+        self.config_name = os.environ.get("AGENTAINER_MODEL_CONFIG", "tiny")
+        self.checkpoint = os.environ.get("AGENTAINER_CHECKPOINT", "")
+        self.chips = tuple(
+            int(c) for c in os.environ.get("AGENTAINER_CHIPS", "0").split(",") if c != ""
+        )
+        self.store = StoreClient.from_env()
+        self.started_at = time.time()
+        self.requests_total = 0
+        self.engine = None
+        self.engine_error = ""
+
+    @property
+    def convo_key(self) -> str:
+        return f"agent:{self.agent_id}:conversations"
+
+    def _load_engine(self) -> None:
+        """Build the JAX engine (slow: compile + weight init). Runs in a
+        thread at startup so /health can answer while loading."""
+        try:
+            from .llm import LLMEngine
+
+            self.engine = LLMEngine.create(
+                config_name=self.config_name,
+                checkpoint=self.checkpoint,
+                agent_id=self.agent_id,
+                store=self.store,
+            )
+        except Exception as e:  # engine stays None; /chat reports 503
+            self.engine_error = f"{type(e).__name__}: {e}"
+
+    def app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/", self.h_root)
+        app.router.add_get("/health", self.h_health)
+        app.router.add_post("/chat", self.h_chat)
+        app.router.add_post("/generate", self.h_generate)
+        app.router.add_get("/history", self.h_history)
+        app.router.add_post("/clear", self.h_clear)
+        app.router.add_get("/metrics", self.h_metrics)
+
+        async def boot(app):
+            app["loader"] = asyncio.create_task(asyncio.to_thread(self._load_engine))
+
+        async def cleanup(app):
+            if self.engine is not None:
+                await asyncio.to_thread(self.engine.shutdown)
+            await self.store.close()
+
+        app.on_startup.append(boot)
+        app.on_cleanup.append(cleanup)
+        return app
+
+    async def h_root(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "agent": self.agent_name,
+                "engine": "llm",
+                "model": self.config_name,
+                "chips": list(self.chips),
+                "status": "running" if self.engine else "loading",
+            }
+        )
+
+    async def h_health(self, request: web.Request) -> web.Response:
+        self.requests_total += 1
+        return web.json_response(
+            {
+                "status": "healthy",
+                "agent_id": self.agent_id,
+                "model_loaded": self.engine is not None,
+                "uptime_s": time.time() - self.started_at,
+            }
+        )
+
+    async def _ensure_engine(self) -> web.Response | None:
+        if self.engine is not None:
+            return None
+        if self.engine_error:
+            return web.json_response(
+                {"error": f"model runtime failed to load: {self.engine_error}"}, status=503
+            )
+        return web.json_response({"error": "model still loading"}, status=503)
+
+    async def h_chat(self, request: web.Request) -> web.Response:
+        self.requests_total += 1
+        err = await self._ensure_engine()
+        if err is not None:
+            return err
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        message = str(body.get("message", ""))
+        session = str(body.get("session", "default"))
+        max_tokens = int(body.get("max_tokens", 64))
+        request_id = request.headers.get("X-Agentainer-Request-ID", "")
+
+        result = await self.engine.chat(
+            session=session, message=message, max_tokens=max_tokens, request_id=request_id
+        )
+        now = time.time()
+        try:
+            await self.store.rpush(
+                self.convo_key,
+                json.dumps({"role": "user", "content": message, "ts": now, "session": session}),
+                json.dumps(
+                    {"role": "assistant", "content": result["text"], "ts": now, "session": session}
+                ),
+            )
+            await self.store.ltrim(self.convo_key, -2 * MAX_TURNS, -1)
+        except Exception:
+            pass
+        return web.json_response(
+            {
+                "response": result["text"],
+                "agent": self.agent_name,
+                "model": self.config_name,
+                "usage": {
+                    "prompt_tokens": result["prompt_tokens"],
+                    "completion_tokens": result["completion_tokens"],
+                },
+                "ttft_ms": result.get("ttft_ms"),
+            }
+        )
+
+    async def h_generate(self, request: web.Request) -> web.Response:
+        """Raw completion endpoint (no conversation memory)."""
+        self.requests_total += 1
+        err = await self._ensure_engine()
+        if err is not None:
+            return err
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        result = await self.engine.generate(
+            prompt=str(body.get("prompt", "")),
+            max_tokens=int(body.get("max_tokens", 64)),
+            temperature=float(body.get("temperature", 0.0)),
+            request_id=request.headers.get("X-Agentainer-Request-ID", ""),
+        )
+        return web.json_response(result)
+
+    async def h_history(self, request: web.Request) -> web.Response:
+        self.requests_total += 1
+        try:
+            raw = await self.store.lrange(self.convo_key, 0, -1)
+        except Exception:
+            raw = []
+        turns = []
+        for item in raw:
+            try:
+                turns.append(json.loads(item))
+            except json.JSONDecodeError:
+                continue
+        return web.json_response({"history": turns, "count": len(turns)})
+
+    async def h_clear(self, request: web.Request) -> web.Response:
+        self.requests_total += 1
+        try:
+            await self.store.delete(self.convo_key)
+        except Exception:
+            pass
+        if self.engine is not None:
+            await asyncio.to_thread(self.engine.clear_sessions)
+        return web.json_response({"status": "cleared"})
+
+    async def h_metrics(self, request: web.Request) -> web.Response:
+        doc = {
+            "engine": "llm",
+            "model": self.config_name,
+            "requests_total": self.requests_total,
+            "uptime_s": time.time() - self.started_at,
+            "model_loaded": self.engine is not None,
+        }
+        if self.engine is not None:
+            doc.update(self.engine.metrics())
+        return web.json_response(doc)
+
+
+def serve() -> None:
+    app_obj = LLMServeApp()
+    port = int(os.environ.get("AGENTAINER_PORT", "8000"))
+    web.run_app(app_obj.app(), host="127.0.0.1", port=port, print=None)

@@ -1,0 +1,129 @@
+"""Backup / restore — durable snapshots of the control plane's desired state.
+
+Re-implements the reference backup manager (internal/backup/manager.go):
+a backup is a JSON manifest ``backup-{unix}`` under the data dir holding
+every agent record; restore re-deploys each agent with a ``-restored`` name
+suffix (manager.go:156-191); export bundles everything into one tar.gz
+(manager.go:397-456).
+
+Where the reference tars host volume directories (manager.go:241-328), the
+TPU equivalent snapshots the agent's *application state in the store*:
+conversation history and (optionally) serialized KV-cache blobs, so a
+restore brings conversations back, not just specs.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import tarfile
+import time
+from pathlib import Path
+
+from ..core.errors import InvalidInput
+from ..core.spec import Agent, HealthCheckConfig, ModelRef, Resources
+from ..manager.agents import AgentManager
+from ..store.base import Store
+from ..store.schema import Keys
+
+
+class BackupManager:
+    def __init__(self, manager: AgentManager, store: Store, data_dir: str | Path):
+        self.manager = manager
+        self.store = store
+        self.dir = Path(data_dir).expanduser() / "backups"
+        self.dir.mkdir(parents=True, exist_ok=True)
+
+    def _path(self, backup_id: str) -> Path:
+        if "/" in backup_id or ".." in backup_id:
+            raise InvalidInput(f"bad backup id: {backup_id}")
+        return self.dir / f"{backup_id}.json"
+
+    def create(self, name: str = "", description: str = "") -> dict:
+        # nanosecond id: two backups in the same second must not collide
+        backup_id = f"backup-{time.time_ns()}"
+        agents = self.manager.list_agents(sync_first=False)
+        manifest = {
+            "id": backup_id,
+            "name": name or backup_id,
+            "description": description,
+            "created_at": time.time(),
+            "version": "1",
+            "agents": [a.to_dict() for a in agents],
+            "app_state": {a.id: self._app_state(a.id) for a in agents},
+        }
+        self._path(backup_id).write_text(json.dumps(manifest, indent=2))
+        return {k: manifest[k] for k in ("id", "name", "description", "created_at")} | {
+            "agents": len(agents)
+        }
+
+    def _app_state(self, agent_id: str) -> dict:
+        state: dict = {}
+        convo = self.store.lrange(Keys.conversations(agent_id), 0, -1)
+        if convo:
+            state["conversations"] = [c.decode("utf-8", "replace") for c in convo]
+        kv_keys = self.store.keys(Keys.kvcache_pattern(agent_id))
+        if kv_keys:
+            state["kvcache"] = {
+                k: base64.b64encode(self.store.get(k) or b"").decode() for k in kv_keys
+            }
+        return state
+
+    def list(self) -> list[dict]:
+        out = []
+        for path in sorted(self.dir.glob("backup-*.json")):
+            try:
+                m = json.loads(path.read_text())
+                out.append(
+                    {
+                        "id": m["id"],
+                        "name": m.get("name", ""),
+                        "description": m.get("description", ""),
+                        "created_at": m.get("created_at", 0),
+                        "agents": len(m.get("agents", [])),
+                    }
+                )
+            except (json.JSONDecodeError, KeyError):
+                continue
+        return out
+
+    def restore(self, backup_id: str) -> list[dict]:
+        path = self._path(backup_id)
+        if not path.exists():
+            raise InvalidInput(f"backup not found: {backup_id}")
+        manifest = json.loads(path.read_text())
+        restored = []
+        for record in manifest.get("agents", []):
+            old = Agent.from_dict(record)
+            agent = self.manager.deploy(
+                name=f"{old.name}-restored",  # manager.go:156-191 parity
+                model=old.model,
+                env=old.env,
+                resources=old.resources,
+                auto_restart=old.auto_restart,
+                token=old.token,
+                health_check=old.health_check,
+            )
+            state = manifest.get("app_state", {}).get(old.id, {})
+            for line in state.get("conversations", []):
+                self.store.rpush(Keys.conversations(agent.id), line)
+            for key, blob_b64 in state.get("kvcache", {}).items():
+                session = key.rsplit(":", 1)[-1]
+                self.store.set(Keys.kvcache(agent.id, session), base64.b64decode(blob_b64))
+            restored.append(agent.to_dict())
+        return restored
+
+    def delete(self, backup_id: str) -> None:
+        path = self._path(backup_id)
+        if not path.exists():
+            raise InvalidInput(f"backup not found: {backup_id}")
+        path.unlink()
+
+    def export(self, backup_id: str, out_path: str | Path) -> Path:
+        path = self._path(backup_id)
+        if not path.exists():
+            raise InvalidInput(f"backup not found: {backup_id}")
+        out = Path(out_path)
+        with tarfile.open(out, "w:gz") as tar:
+            tar.add(path, arcname=path.name)
+        return out

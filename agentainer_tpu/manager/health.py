@@ -1,0 +1,133 @@
+"""Health monitor — engine liveness checks with auto-restart escalation.
+
+Re-implements the reference monitor (internal/health/monitor.go): one
+monitoring loop per agent on the agent's configured cadence (defaults
+30s/5s/3 retries, monitor.go:117-129); a check probes the agent's health
+endpoint; 2xx → healthy, anything else increments the failure count
+(monitor.go:245-250); when failures reach the retry cap and the agent has
+auto-restart, the manager restarts it and the counter resets
+(monitor.go:273-297). Status is cached in memory and stored at
+``health:{id}`` with a 24h TTL (monitor.go:267-270).
+
+Fixed vs the reference: monitoring follows the ``agent:status:*`` bus with a
+real pattern subscription (the reference's Subscribe-with-glob never fired,
+monitor.go:299-332), and checks go straight to the engine instead of looping
+through the public proxy with a hardcoded bearer token (monitor.go:225-234).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Awaitable, Callable
+
+from ..core.spec import AgentStatus, HealthCheckConfig
+from ..manager.agents import AgentManager
+from ..store.base import Store
+from ..store.schema import HEALTH_TTL_S, Keys
+
+Dispatch = Callable[..., Awaitable[tuple[int, dict, bytes]]]
+
+
+class HealthMonitor:
+    def __init__(self, manager: AgentManager, store: Store, dispatch: Dispatch):
+        self.manager = manager
+        self.store = store
+        self.dispatch = dispatch
+        self._tasks: dict[str, asyncio.Task] = {}
+        self._status: dict[str, dict] = {}
+        self._unsub = None
+        self.restarts_total = 0
+
+    async def start(self) -> None:
+        """Attach to the status bus and begin monitoring running agents."""
+        loop = asyncio.get_running_loop()
+
+        def on_status(channel: str, message: str) -> None:
+            agent_id = channel.rsplit(":", 1)[-1]
+            if message == AgentStatus.RUNNING.value:
+                loop.call_soon_threadsafe(self.start_monitoring, agent_id)
+            elif message in (AgentStatus.STOPPED.value, AgentStatus.PAUSED.value):
+                loop.call_soon_threadsafe(self.stop_monitoring, agent_id)
+
+        self._unsub = self.store.on_message(Keys.STATUS_CHANNEL_PATTERN, on_status)
+        for agent in self.manager.list_agents(sync_first=False):
+            if agent.status == AgentStatus.RUNNING and agent.health_check:
+                self.start_monitoring(agent.id)
+
+    async def stop(self) -> None:
+        if self._unsub:
+            self._unsub()
+        for task in list(self._tasks.values()):
+            task.cancel()
+        for task in list(self._tasks.values()):
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+        self._tasks.clear()
+
+    def start_monitoring(self, agent_id: str) -> None:
+        if agent_id in self._tasks and not self._tasks[agent_id].done():
+            return
+        agent = self.manager.try_get(agent_id)
+        if agent is None or agent.health_check is None:
+            return
+        self._tasks[agent_id] = asyncio.create_task(
+            self._monitor_loop(agent_id, agent.health_check), name=f"health-{agent_id}"
+        )
+
+    def stop_monitoring(self, agent_id: str) -> None:
+        task = self._tasks.pop(agent_id, None)
+        if task:
+            task.cancel()
+
+    def get_status(self, agent_id: str) -> dict:
+        cached = self._status.get(agent_id)
+        if cached:
+            return cached
+        stored = self.store.get_json(Keys.health(agent_id))
+        return stored or {"agent_id": agent_id, "status": "unknown", "failures": 0}
+
+    def get_all_statuses(self) -> dict[str, dict]:
+        return dict(self._status)
+
+    async def _monitor_loop(self, agent_id: str, cfg: HealthCheckConfig) -> None:
+        failures = 0
+        while True:
+            healthy = await self.check_once(agent_id, cfg)
+            failures = 0 if healthy else failures + 1
+            self._record(agent_id, healthy, failures)
+            if failures >= cfg.retries:
+                agent = self.manager.try_get(agent_id)
+                if agent is None:
+                    return
+                if agent.auto_restart:
+                    # restart escalation (monitor.go:273-297)
+                    try:
+                        await asyncio.to_thread(self.manager.restart, agent_id)
+                        self.restarts_total += 1
+                    except Exception:
+                        pass
+                    failures = 0
+            await asyncio.sleep(cfg.interval_s)
+
+    async def check_once(self, agent_id: str, cfg: HealthCheckConfig) -> bool:
+        try:
+            status, _, _ = await asyncio.wait_for(
+                self.dispatch(agent_id, "GET", cfg.endpoint, {}, b"", request_id=""),
+                timeout=cfg.timeout_s,
+            )
+        except (asyncio.TimeoutError, Exception):
+            return False
+        return 200 <= status < 300
+
+    def _record(self, agent_id: str, healthy: bool, failures: int) -> None:
+        status = {
+            "agent_id": agent_id,
+            "status": "healthy" if healthy else "unhealthy",
+            "failures": failures,
+            "last_check": time.time(),
+        }
+        self._status[agent_id] = status
+        self.store.set_json(Keys.health(agent_id), status, ttl=HEALTH_TTL_S)

@@ -1,0 +1,247 @@
+"""Durable request journal — the signature feature's foundation.
+
+Re-implements the reference's request persistence (internal/requests/
+requests.go:27-275): every request bound for an agent is journaled *before*
+dispatch, with a 24h TTL on the record and the request id RPUSH'd onto the
+agent's pending list; completion LREM's exactly one pending entry and
+archives the response; failure retries up to 3 times then dead-letters.
+
+One deliberate change from the reference: journal entries carry an
+``idempotency key`` (the request id) end-to-end into the engine's batching
+scheduler, so a replay that races an in-flight original cannot run twice —
+the reference only dedupes at the proxy via the X-Agentainer-Replay header
+(server.go:506-522).
+"""
+
+from __future__ import annotations
+
+import base64
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any
+
+from ..store.base import Store
+from ..store.schema import Keys, REQUEST_TTL_S
+
+MAX_RETRIES = 3  # requests.go:95
+
+
+class RequestStatus:
+    PENDING = "pending"
+    PROCESSING = "processing"
+    COMPLETED = "completed"
+    FAILED = "failed"
+
+
+@dataclass
+class JournaledRequest:
+    """Reference Request struct (requests.go:27-49)."""
+
+    id: str
+    agent_id: str
+    method: str
+    path: str
+    headers: dict[str, str]
+    body_b64: str
+    status: str = RequestStatus.PENDING
+    retry_count: int = 0
+    max_retries: int = MAX_RETRIES
+    response: dict[str, Any] | None = None
+    error: str = ""
+    created_at: float = field(default_factory=time.time)
+    updated_at: float = field(default_factory=time.time)
+
+    @property
+    def body(self) -> bytes:
+        return base64.b64decode(self.body_b64) if self.body_b64 else b""
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "id": self.id,
+            "agent_id": self.agent_id,
+            "method": self.method,
+            "path": self.path,
+            "headers": self.headers,
+            "body_b64": self.body_b64,
+            "status": self.status,
+            "retry_count": self.retry_count,
+            "max_retries": self.max_retries,
+            "response": self.response,
+            "error": self.error,
+            "created_at": self.created_at,
+            "updated_at": self.updated_at,
+        }
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "JournaledRequest":
+        return JournaledRequest(
+            id=d["id"],
+            agent_id=d["agent_id"],
+            method=d["method"],
+            path=d["path"],
+            headers=dict(d.get("headers", {})),
+            body_b64=d.get("body_b64", ""),
+            status=d.get("status", RequestStatus.PENDING),
+            retry_count=int(d.get("retry_count", 0)),
+            max_retries=int(d.get("max_retries", MAX_RETRIES)),
+            response=d.get("response"),
+            error=d.get("error", ""),
+            created_at=float(d.get("created_at", 0)),
+            updated_at=float(d.get("updated_at", 0)),
+        )
+
+
+class RequestJournal:
+    def __init__(self, store: Store, ttl_s: float = REQUEST_TTL_S):
+        self.store = store
+        self.ttl_s = ttl_s
+
+    def _save(self, req: JournaledRequest) -> None:
+        req.updated_at = time.time()
+        # keep the record's remaining TTL rather than resetting to 24h on
+        # every touch; first save sets the full window (requests.go:100-107)
+        remaining = self.store.ttl(Keys.request(req.agent_id, req.id))
+        ttl = self.ttl_s if remaining is None else remaining
+        self.store.set_json(Keys.request(req.agent_id, req.id), req.to_dict(), ttl=ttl)
+
+    # -- API (requests.go:64-275) ---------------------------------------
+    def store_request(
+        self,
+        agent_id: str,
+        method: str,
+        path: str,
+        headers: dict[str, str] | None = None,
+        body: bytes = b"",
+        request_id: str | None = None,
+    ) -> JournaledRequest:
+        req = JournaledRequest(
+            id=request_id or str(uuid.uuid4()),
+            agent_id=agent_id,
+            method=method,
+            path=path,
+            headers=dict(headers or {}),
+            body_b64=base64.b64encode(body).decode() if body else "",
+        )
+        self.store.set_json(
+            Keys.request(agent_id, req.id), req.to_dict(), ttl=self.ttl_s
+        )
+        self.store.rpush(Keys.pending(agent_id), req.id)
+        return req
+
+    def get(self, agent_id: str, request_id: str) -> JournaledRequest | None:
+        raw = self.store.get_json(Keys.request(agent_id, request_id))
+        return None if raw is None else JournaledRequest.from_dict(raw)
+
+    def store_response(
+        self,
+        agent_id: str,
+        request_id: str,
+        status_code: int,
+        headers: dict[str, str] | None = None,
+        body: bytes = b"",
+    ) -> None:
+        req = self.get(agent_id, request_id)
+        if req is None:
+            return
+        req.status = RequestStatus.COMPLETED
+        req.response = {
+            "status_code": status_code,
+            "headers": dict(headers or {}),
+            "body_b64": base64.b64encode(body).decode() if body else "",
+        }
+        self._save(req)
+        self.store.lrem(Keys.pending(agent_id), 1, request_id)
+        self.store.rpush(Keys.completed(agent_id), request_id)
+
+    def mark_processing(self, agent_id: str, request_id: str) -> None:
+        """Flag an in-flight dispatch so a racing replay pass cannot run the
+        same request twice (the duplicate-execution gap the reference has:
+        its worker re-reads the whole pending list every 5s tick,
+        replay_worker.go:60-118)."""
+        req = self.get(agent_id, request_id)
+        if req is not None and req.status == RequestStatus.PENDING:
+            req.status = RequestStatus.PROCESSING
+            self._save(req)
+
+    def mark_pending(self, agent_id: str, request_id: str) -> None:
+        """Revert an in-flight entry to pending (engine died mid-dispatch —
+        the crash-heuristic path; no retry is charged)."""
+        req = self.get(agent_id, request_id)
+        if req is not None and req.status == RequestStatus.PROCESSING:
+            req.status = RequestStatus.PENDING
+            self._save(req)
+
+    def mark_failed(self, agent_id: str, request_id: str, error: str) -> None:
+        """Retry accounting: under the cap the id stays pending for the next
+        replay pass; at the cap it is dead-lettered (requests.go:228-275)."""
+        req = self.get(agent_id, request_id)
+        if req is None:
+            return
+        req.retry_count += 1
+        req.error = error
+        if req.retry_count >= req.max_retries:
+            req.status = RequestStatus.FAILED
+            self._save(req)
+            self.store.lrem(Keys.pending(agent_id), 1, request_id)
+            self.store.rpush(Keys.failed(agent_id), request_id)
+        else:
+            req.status = RequestStatus.PENDING
+            self._save(req)
+
+    def pending_ids(self, agent_id: str) -> list[str]:
+        return self.store.lrange_str(Keys.pending(agent_id), 0, -1)
+
+    def pending(self, agent_id: str) -> list[JournaledRequest]:
+        out = []
+        for rid in self.pending_ids(agent_id):
+            req = self.get(agent_id, rid)
+            if req is not None:
+                out.append(req)
+            else:
+                # record expired (24h TTL) — drop the dangling id
+                self.store.lrem(Keys.pending(agent_id), 1, rid)
+        return out
+
+    def by_status(self, agent_id: str, status: str) -> list[JournaledRequest]:
+        if status == RequestStatus.PENDING:
+            return [r for r in self.pending(agent_id) if r.status == RequestStatus.PENDING]
+        if status == RequestStatus.PROCESSING:
+            return [r for r in self.pending(agent_id) if r.status == RequestStatus.PROCESSING]
+        if status == RequestStatus.COMPLETED:
+            key = Keys.completed(agent_id)
+        elif status == RequestStatus.FAILED:
+            key = Keys.failed(agent_id)
+        else:
+            from ..core.errors import InvalidInput
+
+            raise InvalidInput(
+                f"unknown request status {status!r}; known: pending, processing, "
+                "completed, failed"
+            )
+        out = []
+        for rid in self.store.lrange_str(key, 0, -1):
+            req = self.get(agent_id, rid)
+            if req is not None:
+                out.append(req)
+        return out
+
+    def stats(self, agent_id: str) -> dict[str, int]:
+        return {
+            "pending": self.store.llen(Keys.pending(agent_id)),
+            "completed": self.store.llen(Keys.completed(agent_id)),
+            "failed": self.store.llen(Keys.failed(agent_id)),
+        }
+
+    def agents_with_pending(self) -> list[str]:
+        """Agents that currently have queued requests.
+
+        Uses SCAN-style iteration, not the reference's blocking KEYS on every
+        5s tick (replay_worker.go:60).
+        """
+        out = []
+        for key in self.store.scan(Keys.PENDING_PATTERN):
+            agent_id = key.split(":")[1]
+            if self.store.llen(key) > 0:
+                out.append(agent_id)
+        return out

@@ -1,0 +1,116 @@
+"""Metrics plane — serving counters replacing docker container stats.
+
+The reference samples docker ContainerStats (CPU%, memory, net, blkio) per
+agent every 10s into ``metrics:current:{id}`` (1h TTL) and a 24h
+``metrics:history:{id}`` sorted set (pkg/metrics/collector.go:202-322) — but
+its collector is effectively dormant because registration depends on stubbed
+storage + a broken pattern subscription (collector.go:92-101,324-355;
+SURVEY.md §2 #9). Here the collector iterates live agents each tick, so it
+cannot go dormant, and the sample unit is what matters on a TPU: request
+throughput and latency from the proxy, plus engine counters (tokens/s, TTFT,
+batch occupancy, KV/HBM usage) pulled from ``Backend.stats``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+
+from ..core.spec import AgentStatus
+from ..manager.agents import AgentManager
+from ..store.base import Store
+from ..store.schema import Keys, METRICS_CURRENT_TTL_S, METRICS_HISTORY_S
+
+
+class MetricsPlane:
+    def __init__(self, manager: AgentManager, store: Store, interval_s: float = 10.0):
+        self.manager = manager
+        self.store = store
+        self.interval_s = interval_s
+        self._lock = threading.Lock()
+        self._counters: dict[str, dict] = {}
+        self._task: asyncio.Task | None = None
+
+    # -- proxy-side accounting ------------------------------------------
+    def count_request(self, agent_id: str, latency_s: float = 0.0) -> None:
+        with self._lock:
+            c = self._counters.setdefault(
+                agent_id, {"requests": 0, "latency_sum": 0.0, "latency_max": 0.0}
+            )
+            c["requests"] += 1
+            c["latency_sum"] += latency_s
+            c["latency_max"] = max(c["latency_max"], latency_s)
+
+    def _drain_counters(self, agent_id: str) -> dict:
+        with self._lock:
+            c = self._counters.pop(agent_id, None)
+        if not c or not c["requests"]:
+            return {"requests": 0, "latency_avg_s": 0.0, "latency_max_s": 0.0}
+        return {
+            "requests": c["requests"],
+            "latency_avg_s": c["latency_sum"] / c["requests"],
+            "latency_max_s": c["latency_max"],
+        }
+
+    # -- collection loop (collector.go:202-221 cadence) ------------------
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._loop(), name="metrics-collector")
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval_s)
+            try:
+                await asyncio.to_thread(self.sample_all)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass
+
+    def sample_all(self) -> None:
+        for agent in self.manager.list_agents(sync_first=False):
+            if agent.status == AgentStatus.RUNNING:
+                self.sample_agent(agent.id)
+
+    def sample_agent(self, agent_id: str) -> dict:
+        agent = self.manager.try_get(agent_id)
+        if agent is None:
+            return {}
+        now = time.time()
+        sample = {"ts": now, "agent_id": agent_id, "proxy": self._drain_counters(agent_id)}
+        if agent.engine_id:
+            engine_stats = self.manager.backend.stats(agent.engine_id)
+            if engine_stats:
+                sample["engine"] = engine_stats
+        placement = self.manager.scheduler.placement(agent_id)
+        if placement:
+            sample["placement"] = placement.to_dict()
+        self.store.set_json(Keys.metrics_current(agent_id), sample, ttl=METRICS_CURRENT_TTL_S)
+        import json
+
+        self.store.zadd(Keys.metrics_history(agent_id), now, json.dumps(sample))
+        self.store.zremrangebyscore(Keys.metrics_history(agent_id), 0, now - METRICS_HISTORY_S)
+        return sample
+
+    # -- query APIs (collector.go:158-200) -------------------------------
+    def current(self, agent_id: str) -> dict:
+        return self.store.get_json(Keys.metrics_current(agent_id)) or {}
+
+    def history(self, agent_id: str, since: float, until: float) -> list[dict]:
+        import json
+
+        out = []
+        for raw in self.store.zrangebyscore(Keys.metrics_history(agent_id), since, until):
+            try:
+                out.append(json.loads(raw))
+            except json.JSONDecodeError:
+                continue
+        return out

@@ -1,0 +1,100 @@
+"""Replay worker — drains the journal back into live agents.
+
+Re-implements the reference ReplayWorker (internal/requests/
+replay_worker.go:16-198): a background loop on a 5s cadence finds agents with
+pending journaled requests, checks the agent is running, and re-dispatches
+each request. Two deliberate fixes over the reference:
+
+- pending agents are discovered with SCAN-style iteration instead of a
+  blocking ``KEYS agent:*:requests:pending`` every tick (replay_worker.go:60);
+- replay dispatches straight into the proxy's dispatch function in-process
+  (settling the same journal entry, idempotent by request id) instead of
+  re-entering the server over localhost HTTP with magic headers
+  (replay_worker.go:120-163).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Awaitable, Callable
+
+from ..core.spec import AgentStatus
+from ..manager.agents import AgentManager
+from ..manager.journal import RequestJournal, RequestStatus
+
+# dispatch(agent_id, method, path, headers, body, request_id) -> (status, headers, body)
+Dispatch = Callable[..., Awaitable[tuple[int, dict, bytes]]]
+
+
+class ReplayWorker:
+    def __init__(
+        self,
+        journal: RequestJournal,
+        manager: AgentManager,
+        dispatch: Dispatch,
+        interval_s: float = 5.0,
+    ):
+        self.journal = journal
+        self.manager = manager
+        self.dispatch = dispatch
+        self.interval_s = interval_s
+        # entries stuck PROCESSING longer than this are treated as orphaned
+        # (daemon crashed mid-dispatch; 2x the proxy's 30s client timeout)
+        self.processing_stale_s = 60.0
+        self._task: asyncio.Task | None = None
+        self.replayed_total = 0
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._loop(), name="replay-worker")
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval_s)
+            try:
+                await self.scan_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass
+
+    async def scan_once(self) -> int:
+        """One replay pass; returns number of successfully replayed requests."""
+        replayed = 0
+        for agent_id in self.journal.agents_with_pending():
+            agent = self.manager.try_get(agent_id)
+            # only replay into running agents (replay_worker.go:166-189)
+            if agent is None or agent.status != AgentStatus.RUNNING:
+                continue
+            for req in self.journal.pending(agent_id):
+                if req.status == RequestStatus.PROCESSING:
+                    # in flight right now — unless the entry is stale (the
+                    # daemon died mid-dispatch and nothing will ever settle
+                    # it), in which case reclaim it
+                    if time.time() - req.updated_at < self.processing_stale_s:
+                        continue
+                    self.journal.mark_pending(agent_id, req.id)
+                elif req.status != RequestStatus.PENDING:
+                    continue
+                status, _, _ = await self.dispatch(
+                    agent_id,
+                    req.method,
+                    req.path,
+                    req.headers,
+                    req.body,
+                    request_id=req.id,
+                )
+                if status >= 0:
+                    replayed += 1
+                else:
+                    break  # engine went away mid-drain; next tick retries
+        self.replayed_total += replayed
+        return replayed

@@ -1,0 +1,31 @@
+"""Engine subprocess entry point: ``python -m agentainer_tpu.runtime.engine_main``.
+
+The analogue of a container's CMD (reference examples/gpt-agent/Dockerfile
+runs gunicorn app:app). The LocalBackend spawns this with the agent's
+identity, port, chip assignment, and control-plane URL in the environment.
+Engine selection stays lazy so the echo engine never imports JAX.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> None:
+    engine = os.environ.get("AGENTAINER_ENGINE", "echo")
+    if engine == "echo":
+        from ..engine.echo import serve
+
+        serve()
+    elif engine == "llm":
+        from ..engine.llm_serve import serve
+
+        serve()
+    else:
+        print(f"unknown engine {engine!r}", file=sys.stderr)
+        sys.exit(2)
+
+
+if __name__ == "__main__":
+    main()

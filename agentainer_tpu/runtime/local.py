@@ -1,0 +1,381 @@
+"""Local process backend — real engine subprocesses on the TPU-VM.
+
+This is the production stand-in for the reference's Docker daemon: each agent
+engine runs as an OS process serving HTTP on a localhost port (the analogue
+of a container serving :8000 on the bridge network, reference agent.go:431-508
++ server.go:546), with:
+
+- graceful stop: SIGTERM then SIGKILL after the reference's 10s deadline
+  (agent.go:183-215);
+- pause/resume via SIGSTOP/SIGCONT (docker pause/unpause);
+- restart policy: when the agent was deployed with auto-restart, a watcher
+  respawns the engine on unexpected exit (RestartPolicy "always" iff
+  AutoRestart, agent.go:482-495);
+- engine events pushed to the reconciler when the watcher observes a state
+  change (Docker event stream analogue, state_sync.go:253-309);
+- stdout/stderr captured to per-engine log files for ``GetLogs`` parity
+  (agent.go:411-429).
+
+TPU chip binding: engines receive their chip assignment via env and carve
+the slice with ``TPU_VISIBLE_DEVICES``/``JAX_PLATFORMS`` so two engines never
+fight over the same chips.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable
+
+from ..core.spec import Agent
+from ..store.base import Store
+from .backend import Backend, EngineInfo, EngineState
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class _EngineRec:
+    engine_id: str
+    agent_id: str
+    port: int
+    cmd: list[str]
+    env: dict[str, str]
+    chips: tuple[int, ...]
+    auto_restart: bool
+    log_path: Path
+    proc: subprocess.Popen | None = None
+    paused: bool = False
+    desired_running: bool = False
+    restarts: int = 0
+    log_file: object = None
+
+
+class LocalBackend(Backend):
+    def __init__(
+        self,
+        store: Store | None = None,
+        data_dir: str | Path | None = None,
+        python: str = sys.executable,
+        ready_timeout_s: float = 60.0,
+    ):
+        self.store = store
+        self.python = python
+        self.ready_timeout_s = ready_timeout_s
+        self.control_url = ""
+        self.internal_token = ""
+        self._dir = Path(data_dir or tempfile.mkdtemp(prefix="atpu-engines-")).expanduser()
+        (self._dir / "engines").mkdir(parents=True, exist_ok=True)
+        self._lock = threading.RLock()
+        self._recs: dict[str, _EngineRec] = {}
+        self._listeners: list[Callable[[str, EngineState], None]] = []
+        self._watcher = threading.Thread(target=self._watch_loop, daemon=True)
+        self._closed = False
+        self._watcher.start()
+
+    def set_control(self, url: str, token: str = "") -> None:
+        """Tell engines where the control plane (and its store API) lives.
+
+        ``token`` is accepted for backward compatibility but unused: engines
+        authenticate with per-engine tokens minted at create_engine, never
+        the admin bearer token.
+        """
+        self.control_url = url
+
+    # -- backend interface ----------------------------------------------
+    def create_engine(self, agent: Agent, chips: tuple[int, ...]) -> str:
+        engine_id = f"eng-{uuid.uuid4().hex[:12]}"
+        port = _free_port()
+        # Per-engine store credential: engines never see the admin token, and
+        # the control plane validates this one against internal:token:{id}
+        # (outside the namespace engines can reach).
+        engine_token = uuid.uuid4().hex + uuid.uuid4().hex
+        if self.store is not None:
+            from ..store.schema import Keys
+
+            self.store.set(Keys.internal_token(agent.id), engine_token)
+        env = dict(os.environ)
+        env.update(agent.env)
+        env.update(
+            {
+                "AGENTAINER_AGENT_ID": agent.id,
+                "AGENTAINER_AGENT_NAME": agent.name,
+                "AGENTAINER_ENGINE": agent.model.engine,
+                "AGENTAINER_MODEL_CONFIG": agent.model.config,
+                "AGENTAINER_CHECKPOINT": agent.model.checkpoint,
+                "AGENTAINER_PORT": str(port),
+                "AGENTAINER_CHIPS": ",".join(map(str, chips)),
+                "AGENTAINER_CONTROL_URL": self.control_url,
+                "AGENTAINER_INTERNAL_TOKEN": engine_token,
+            }
+        )
+        if agent.model.engine != "llm":
+            # non-TPU engines must not grab the TPU runtime
+            env["JAX_PLATFORMS"] = "cpu"
+        cmd = [self.python, "-m", "agentainer_tpu.runtime.engine_main"]
+        rec = _EngineRec(
+            engine_id=engine_id,
+            agent_id=agent.id,
+            port=port,
+            cmd=cmd,
+            env=env,
+            chips=chips,
+            auto_restart=agent.auto_restart,
+            log_path=self._dir / "engines" / f"{engine_id}.log",
+        )
+        with self._lock:
+            self._recs[engine_id] = rec
+        return engine_id
+
+    def start_engine(self, engine_id: str) -> None:
+        with self._lock:
+            rec = self._require(engine_id)
+            if rec.proc is not None and rec.proc.poll() is None:
+                rec.desired_running = True
+                return
+            self._spawn(rec)
+            rec.desired_running = True
+        self._wait_ready(rec)
+        self._emit(engine_id, EngineState.RUNNING)
+
+    def _spawn(self, rec: _EngineRec) -> None:
+        rec.log_file = open(rec.log_path, "ab")
+        rec.env["AGENTAINER_CONTROL_URL"] = self.control_url
+        rec.proc = subprocess.Popen(
+            rec.cmd,
+            env=rec.env,
+            stdout=rec.log_file,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,  # isolate signals from the daemon
+        )
+        rec.paused = False
+
+    def _wait_ready(self, rec: _EngineRec) -> None:
+        """Block until the engine answers /health (containers have no such
+        gate in the reference; engines do because JAX init takes seconds and
+        a 'started' engine should be servable)."""
+        import http.client
+
+        deadline = time.time() + self.ready_timeout_s
+        while time.time() < deadline:
+            if rec.proc is None or rec.proc.poll() is not None:
+                raise RuntimeError(
+                    f"engine {rec.engine_id} exited during startup; "
+                    f"log: {self._tail_log(rec, 20)}"
+                )
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", rec.port, timeout=1.0)
+                conn.request("GET", "/health")
+                if conn.getresponse().status == 200:
+                    conn.close()
+                    return
+                conn.close()
+            except OSError:
+                time.sleep(0.05)
+        raise RuntimeError(f"engine {rec.engine_id} not ready after {self.ready_timeout_s}s")
+
+    def stop_engine(self, engine_id: str, timeout_s: float = 10.0) -> None:
+        with self._lock:
+            rec = self._require(engine_id)
+            rec.desired_running = False
+            proc = rec.proc
+        if proc is None or proc.poll() is not None:
+            return
+        if rec.paused:
+            try:
+                os.killpg(proc.pid, signal.SIGCONT)
+            except (ProcessLookupError, PermissionError):
+                pass
+            rec.paused = False
+        try:
+            proc.terminate()
+            proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()  # hard kill after grace (agent.go:194 10s deadline)
+            proc.wait(timeout=5)
+        except ProcessLookupError:
+            pass
+        self._emit(engine_id, EngineState.EXITED)
+
+    def pause_engine(self, engine_id: str) -> None:
+        with self._lock:
+            rec = self._require(engine_id)
+            if rec.proc is None or rec.proc.poll() is not None:
+                raise RuntimeError(f"engine {engine_id} not running")
+            os.killpg(rec.proc.pid, signal.SIGSTOP)
+            rec.paused = True
+        self._emit(engine_id, EngineState.PAUSED)
+
+    def resume_engine(self, engine_id: str) -> None:
+        with self._lock:
+            rec = self._require(engine_id)
+            if rec.proc is None or rec.proc.poll() is not None:
+                raise RuntimeError(f"engine {engine_id} not running")
+            os.killpg(rec.proc.pid, signal.SIGCONT)
+            rec.paused = False
+        self._emit(engine_id, EngineState.RUNNING)
+
+    def remove_engine(self, engine_id: str) -> None:
+        with self._lock:
+            rec = self._recs.pop(engine_id, None)
+        if rec is None:
+            return
+        if rec.proc is not None and rec.proc.poll() is None:
+            try:
+                os.killpg(rec.proc.pid, signal.SIGKILL)
+                rec.proc.wait(timeout=5)
+            except (ProcessLookupError, subprocess.TimeoutExpired):
+                pass
+        if rec.log_file is not None:
+            try:
+                rec.log_file.close()
+            except OSError:
+                pass
+
+    def engine_info(self, engine_id: str) -> EngineInfo | None:
+        with self._lock:
+            rec = self._recs.get(engine_id)
+            if rec is None:
+                return None
+            return EngineInfo(
+                engine_id=engine_id,
+                agent_id=rec.agent_id,
+                state=self._state(rec),
+                endpoint=f"http://127.0.0.1:{rec.port}",
+                chips=rec.chips,
+            )
+
+    def _state(self, rec: _EngineRec) -> EngineState:
+        if rec.proc is None:
+            return EngineState.CREATED
+        if rec.proc.poll() is not None:
+            return EngineState.EXITED
+        return EngineState.PAUSED if rec.paused else EngineState.RUNNING
+
+    def list_engines(self) -> list[EngineInfo]:
+        with self._lock:
+            ids = list(self._recs)
+        return [info for eid in ids if (info := self.engine_info(eid)) is not None]
+
+    def logs(self, engine_id: str, tail: int = 100) -> list[str]:
+        with self._lock:
+            rec = self._recs.get(engine_id)
+        if rec is None:
+            return []
+        return self._tail_log(rec, tail)
+
+    def _tail_log(self, rec: _EngineRec, tail: int) -> list[str]:
+        try:
+            with open(rec.log_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - 256 * 1024))
+                lines = f.read().decode("utf-8", "replace").splitlines()
+            return lines[-tail:]
+        except OSError:
+            return []
+
+    def stats(self, engine_id: str) -> dict | None:
+        """Pull serving counters from the engine's /metrics (the
+        ContainerStats analogue, collector.go:228)."""
+        with self._lock:
+            rec = self._recs.get(engine_id)
+            if rec is None or rec.proc is None or rec.proc.poll() is not None or rec.paused:
+                return None
+            port = rec.port
+        import http.client
+        import json as _json
+
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2.0)
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            data = _json.loads(resp.read()) if resp.status == 200 else None
+            conn.close()
+            return data
+        except (OSError, ValueError):
+            return None
+
+    def subscribe_events(self, callback: Callable[[str, EngineState], None]) -> Callable[[], None]:
+        self._listeners.append(callback)
+
+        def unsub() -> None:
+            if callback in self._listeners:
+                self._listeners.remove(callback)
+
+        return unsub
+
+    def _emit(self, engine_id: str, state: EngineState) -> None:
+        for cb in list(self._listeners):
+            try:
+                cb(engine_id, state)
+            except Exception:
+                pass
+
+    # -- restart-policy watcher (docker events + RestartPolicy analogue) --
+    def _watch_loop(self) -> None:
+        last: dict[str, EngineState] = {}
+        while not self._closed:
+            time.sleep(0.2)
+            with self._lock:
+                recs = list(self._recs.values())
+            for rec in recs:
+                state = self._state(rec)
+                if last.get(rec.engine_id) != state:
+                    if rec.engine_id in last:
+                        self._emit(rec.engine_id, state)
+                    last[rec.engine_id] = state
+                if (
+                    state == EngineState.EXITED
+                    and rec.desired_running
+                    and rec.auto_restart
+                    and not self._closed
+                ):
+                    try:
+                        with self._lock:
+                            self._spawn(rec)
+                            rec.restarts += 1
+                        self._wait_ready(rec)
+                        self._emit(rec.engine_id, EngineState.RUNNING)
+                        last[rec.engine_id] = EngineState.RUNNING
+                    except Exception:
+                        rec.desired_running = False
+
+    def close(self) -> None:
+        self._closed = True
+        with self._lock:
+            ids = list(self._recs)
+        for engine_id in ids:
+            try:
+                self.stop_engine(engine_id, timeout_s=2.0)
+            except Exception:
+                pass
+            self.remove_engine(engine_id)
+
+    def _require(self, engine_id: str) -> _EngineRec:
+        rec = self._recs.get(engine_id)
+        if rec is None:
+            raise KeyError(f"no such engine: {engine_id}")
+        return rec
+
+    # -- test helper ------------------------------------------------------
+    def kill_engine_hard(self, engine_id: str) -> None:
+        """SIGKILL without touching desired state — a real crash."""
+        with self._lock:
+            rec = self._require(engine_id)
+            if rec.proc is not None and rec.proc.poll() is None:
+                os.killpg(rec.proc.pid, signal.SIGKILL)
+                rec.proc.wait(timeout=5)

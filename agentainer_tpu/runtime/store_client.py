@@ -1,0 +1,142 @@
+"""Async store client for engine subprocesses.
+
+The reference's agents connect to Redis directly over the bridge network
+(examples/gpt-agent/app.py:20-27). Engines here reach the daemon's store
+through the authenticated ``/internal/store`` endpoint, namespaced to their
+own ``agent:{id}:*`` keys. Falls back to process-local memory when no
+control URL is configured (standalone engine runs, unit tests).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import aiohttp
+
+
+class StoreClient:
+    def __init__(self, control_url: str = "", token: str = "", agent_id: str = ""):
+        self.control_url = control_url.rstrip("/")
+        self.token = token
+        self.agent_id = agent_id
+        self._session: aiohttp.ClientSession | None = None
+        self._local: dict[str, Any] = {}  # fallback when no control plane
+
+    @classmethod
+    def from_env(cls) -> "StoreClient":
+        return cls(
+            control_url=os.environ.get("AGENTAINER_CONTROL_URL", ""),
+            token=os.environ.get("AGENTAINER_INTERNAL_TOKEN", ""),
+            agent_id=os.environ.get("AGENTAINER_AGENT_ID", ""),
+        )
+
+    @property
+    def connected(self) -> bool:
+        return bool(self.control_url)
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    async def _op(self, op: str, key: str, **kw: Any) -> Any:
+        if not self.connected:
+            return self._local_op(op, key, **kw)
+        if self._session is None:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=10),
+                headers={
+                    "Authorization": f"Bearer {self.token}",
+                    "X-Agentainer-Agent-ID": self.agent_id,
+                },
+            )
+        async with self._session.post(
+            f"{self.control_url}/internal/store", json={"op": op, "key": key, **kw}
+        ) as resp:
+            doc = await resp.json()
+            if resp.status != 200:
+                raise RuntimeError(f"store op {op} failed: {doc.get('message')}")
+            return doc.get("data")
+
+    def _local_op(self, op: str, key: str, **kw: Any) -> Any:
+        d = self._local
+        if op == "get":
+            return d.get(key)
+        if op == "set":
+            d[key] = kw.get("value", "")
+            return None
+        if op == "set_b64":
+            d[key] = kw.get("value_b64", "")
+            return None
+        if op == "get_b64":
+            return d.get(key)
+        if op == "delete":
+            return 1 if d.pop(key, None) is not None else 0
+        if op == "rpush":
+            d.setdefault(key, []).extend(kw.get("values", []))
+            return len(d[key])
+        if op == "lrange":
+            lst = d.get(key, [])
+            stop = kw.get("stop", -1)
+            return lst[kw.get("start", 0) : (stop + 1 if stop != -1 else None)]
+        if op == "ltrim":
+            lst = d.get(key, [])
+            stop = kw.get("stop", -1)
+            d[key] = lst[kw.get("start", 0) : (stop + 1 if stop != -1 else None)]
+            return None
+        if op == "llen":
+            return len(d.get(key, []))
+        if op == "hincrby":
+            h = d.setdefault(key, {})
+            h[kw.get("field", "")] = int(h.get(kw.get("field", ""), 0)) + kw.get("amount", 1)
+            return h[kw.get("field", "")]
+        if op == "hgetall":
+            return {k: str(v) for k, v in d.get(key, {}).items()}
+        if op == "keys":
+            import fnmatch
+
+            return [k for k in d if fnmatch.fnmatchcase(k, kw.get("pattern", key + "*"))]
+        raise ValueError(f"unknown op {op}")
+
+    # -- typed helpers ---------------------------------------------------
+    async def get(self, key: str) -> str | None:
+        return await self._op("get", key)
+
+    async def set(self, key: str, value: str, ttl: float | None = None) -> None:
+        await self._op("set", key, value=value, ttl=ttl)
+
+    async def set_bytes(self, key: str, blob: bytes, ttl: float | None = None) -> None:
+        import base64
+
+        await self._op("set_b64", key, value_b64=base64.b64encode(blob).decode(), ttl=ttl)
+
+    async def get_bytes(self, key: str) -> bytes | None:
+        import base64
+
+        raw = await self._op("get_b64", key)
+        return None if raw is None else base64.b64decode(raw)
+
+    async def delete(self, key: str) -> int:
+        return await self._op("delete", key)
+
+    async def rpush(self, key: str, *values: str) -> int:
+        return await self._op("rpush", key, values=list(values))
+
+    async def lrange(self, key: str, start: int = 0, stop: int = -1) -> list[str]:
+        return await self._op("lrange", key, start=start, stop=stop) or []
+
+    async def ltrim(self, key: str, start: int, stop: int) -> None:
+        await self._op("ltrim", key, start=start, stop=stop)
+
+    async def llen(self, key: str) -> int:
+        return await self._op("llen", key) or 0
+
+    async def hincrby(self, key: str, field: str, amount: int = 1) -> int:
+        return await self._op("hincrby", key, field=field, amount=amount)
+
+    async def hgetall(self, key: str) -> dict[str, str]:
+        return await self._op("hgetall", key) or {}
+
+    async def keys(self, pattern: str) -> list[str]:
+        return await self._op("keys", pattern.split("*")[0], pattern=pattern) or []

@@ -1,0 +1,557 @@
+"""Control-plane HTTP server: management REST API + per-agent reverse proxy.
+
+Re-implements the reference API server (internal/api/server.go) on aiohttp:
+
+- one port serves a public ``/health``, the **unauthenticated** proxy under
+  ``/agent/{id}/...``, and a bearer-token-authed management surface under
+  ``/agents/*`` plus metrics/logs/audit/backups (route table parity:
+  server.go:69-107; auth middleware parity: server.go:449-478);
+- every response uses the ``{success, message, data}`` envelope
+  (server.go:50-54);
+- the proxy journals each request before dispatch, answers ``202`` with a
+  request id when the agent is not running ("queue for replay",
+  server.go:525-541), rewrites the path by stripping ``/agent/{id}``
+  (server.go:553-557), and classifies outcomes exactly like the reference's
+  interceptTransport (server.go:583-615): success → archive response;
+  connection-refused/engine-gone → leave pending for the replay worker
+  (crash heuristic); other errors → retry-count/dead-letter;
+- replayed requests carry ``X-Agentainer-Request-ID`` +
+  ``X-Agentainer-Replay: true`` and are not re-journaled (server.go:506-522).
+
+Engines whose endpoint is ``http(s)://`` are reached over localhost HTTP
+(the Docker-bridge-DNS analogue); fake test engines are dispatched in-process.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import TYPE_CHECKING
+
+from aiohttp import ClientSession, ClientTimeout, web
+
+from ..core.errors import AgentainerError, AgentNotFound
+from ..core.spec import AgentStatus, HealthCheckConfig, ModelRef, Resources
+from ..manager.journal import RequestStatus
+from ..store.schema import Keys
+
+if TYPE_CHECKING:
+    from ..daemon import Services
+
+REPLAY_HEADER = "X-Agentainer-Replay"
+REQUEST_ID_HEADER = "X-Agentainer-Request-ID"
+
+# dispatch_to_agent sentinel outcomes (never valid HTTP statuses)
+DISPATCH_ENGINE_GONE = -1  # connection refused / engine vanished → stays pending
+DISPATCH_FAILED = -2  # timeout or protocol error → retry accounted
+
+_HOP_BY_HOP = {
+    "connection",
+    "keep-alive",
+    "proxy-authenticate",
+    "proxy-authorization",
+    "te",
+    "trailers",
+    "transfer-encoding",
+    "upgrade",
+    "host",
+    "content-length",
+}
+
+
+def envelope(data=None, message: str = "", success: bool = True) -> dict:
+    return {"success": success, "message": message, "data": data}
+
+
+def ok(data=None, message: str = "", status: int = 200) -> web.Response:
+    return web.json_response(envelope(data, message), status=status)
+
+
+def fail(message: str, status: int = 500) -> web.Response:
+    return web.json_response(envelope(None, message, success=False), status=status)
+
+
+class ControlPlaneApp:
+    def __init__(self, services: "Services"):
+        self.s = services
+        self.app = web.Application(middlewares=[self._error_mw, self._auth_mw])
+        self._routes()
+        self._client: ClientSession | None = None
+        self.app.on_startup.append(self._on_startup)
+        self.app.on_cleanup.append(self._on_cleanup)
+
+    async def _on_startup(self, app) -> None:
+        self._client = ClientSession(timeout=ClientTimeout(total=30))
+
+    async def _on_cleanup(self, app) -> None:
+        if self._client:
+            await self._client.close()
+
+    # -- middleware ------------------------------------------------------
+    @web.middleware
+    async def _error_mw(self, request: web.Request, handler):
+        try:
+            return await handler(request)
+        except web.HTTPException:
+            raise
+        except AgentainerError as e:
+            return fail(str(e), status=e.http_status)
+        except Exception as e:  # pragma: no cover - defensive
+            self.s.logs.error("api", f"unhandled error on {request.path}: {e!r}")
+            return fail(f"internal error: {e}", status=500)
+
+    @web.middleware
+    async def _auth_mw(self, request: web.Request, handler):
+        """Bearer auth on the management surface only; the proxy and /health
+        are public (server.go:75-107,449-478)."""
+        path = request.path
+        # /internal/store authenticates with per-engine tokens in its handler
+        public = path == "/health" or path.startswith("/agent/") or path == "/internal/store"
+        if not public:
+            header = request.headers.get("Authorization", "")
+            token = header.removeprefix("Bearer ").strip()
+            if not header.startswith("Bearer ") or token != self.s.config.auth_token:
+                self.s.logs.audit(
+                    user="unknown",
+                    action="auth",
+                    resource=path,
+                    result="denied",
+                    ip=request.remote or "",
+                    user_agent=request.headers.get("User-Agent", ""),
+                )
+                return fail("unauthorized", status=401)
+        return await handler(request)
+
+    # -- routes (server.go:69-107 parity) -------------------------------
+    def _routes(self) -> None:
+        r = self.app.router
+        r.add_get("/health", self.h_server_health)
+        r.add_route("*", "/agent/{agent_id}/{tail:.*}", self.h_proxy)
+        r.add_route("*", "/agent/{agent_id}", self.h_proxy)
+
+        r.add_post("/agents", self.h_deploy)
+        r.add_get("/agents", self.h_list)
+        r.add_get("/agents/{agent_id}", self.h_get)
+        r.add_delete("/agents/{agent_id}", self.h_remove)
+        for op in ("start", "stop", "restart", "pause", "resume"):
+            r.add_post(f"/agents/{{agent_id}}/{op}", self._lifecycle_handler(op))
+        r.add_get("/agents/{agent_id}/logs", self.h_logs)
+        r.add_get("/agents/{agent_id}/requests", self.h_requests)
+        r.add_post("/agents/{agent_id}/requests/{request_id}/replay", self.h_manual_replay)
+        r.add_get("/agents/{agent_id}/health", self.h_agent_health)
+        r.add_get("/agents/{agent_id}/metrics", self.h_agent_metrics)
+        r.add_get("/agents/{agent_id}/metrics/history", self.h_agent_metrics_history)
+        r.add_get("/metrics", self.h_all_metrics)
+        r.add_get("/logs", self.h_get_logs)
+        r.add_get("/audit", self.h_get_audit)
+        r.add_get("/slice", self.h_slice)
+        r.add_post("/internal/store", self.h_internal_store)
+        r.add_post("/backups", self.h_backup_create)
+        r.add_get("/backups", self.h_backup_list)
+        r.add_post("/backups/{backup_id}/restore", self.h_backup_restore)
+        r.add_delete("/backups/{backup_id}", self.h_backup_delete)
+
+    # -- helpers ---------------------------------------------------------
+    def _audit(self, request: web.Request, action: str, resource: str, result: str) -> None:
+        self.s.logs.audit(
+            user="api-token",
+            action=action,
+            resource=resource,
+            result=result,
+            ip=request.remote or "",
+            user_agent=request.headers.get("User-Agent", ""),
+        )
+
+    async def _mgr(self, fn, *args, **kw):
+        """Lifecycle ops run in a thread: engine spawn can block (JAX init)."""
+        return await asyncio.to_thread(fn, *args, **kw)
+
+    # -- management handlers ---------------------------------------------
+    async def h_server_health(self, request: web.Request) -> web.Response:
+        return ok(
+            {
+                "status": "healthy",
+                "agents": len(self.s.manager.agent_ids()),
+                "slice": self.s.scheduler.topology.name,
+                "time": time.time(),
+            }
+        )
+
+    async def h_deploy(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return fail("invalid JSON body", status=400)
+        agent = await self._mgr(
+            self.s.manager.deploy,
+            name=body.get("name", ""),
+            model=body.get("model", body.get("image", "echo")),
+            env=body.get("env", {}),
+            resources=Resources.from_dict(body.get("resources")),
+            auto_restart=bool(body.get("auto_restart", False)),
+            token=body.get("token", ""),
+            health_check=HealthCheckConfig.from_dict(body.get("health_check")),
+        )
+        self._audit(request, "deploy", agent.id, "success")
+        return ok(self.s.manager.summary(agent), message="Agent deployed successfully")
+
+    async def h_list(self, request: web.Request) -> web.Response:
+        agents = await self._mgr(self.s.manager.list_agents)
+        return ok([self.s.manager.summary(a) for a in agents])
+
+    async def h_get(self, request: web.Request) -> web.Response:
+        agent = self.s.manager.get_agent(request.match_info["agent_id"])
+        return ok(self.s.manager.summary(agent))
+
+    def _lifecycle_handler(self, op: str):
+        async def handler(request: web.Request) -> web.Response:
+            agent_id = request.match_info["agent_id"]
+            fn = getattr(self.s.manager, op)
+            agent = await self._mgr(fn, agent_id)
+            if op in ("start", "restart", "resume") and agent.health_check:
+                self.s.health.start_monitoring(agent.id)
+            if op in ("stop", "pause"):
+                self.s.health.stop_monitoring(agent_id)
+            self._audit(request, op, agent_id, "success")
+            return ok(self.s.manager.summary(agent), message=f"Agent {op} successful")
+
+        return handler
+
+    async def h_remove(self, request: web.Request) -> web.Response:
+        agent_id = request.match_info["agent_id"]
+        self.s.health.stop_monitoring(agent_id)
+        await self._mgr(self.s.manager.remove, agent_id)
+        self._audit(request, "remove", agent_id, "success")
+        return ok(message="Agent removed successfully")
+
+    async def h_logs(self, request: web.Request) -> web.Response:
+        agent_id = request.match_info["agent_id"]
+        tail = int(request.query.get("tail", "100"))
+        lines = await self._mgr(self.s.manager.logs, agent_id, tail)
+        return ok({"logs": lines})
+
+    async def h_requests(self, request: web.Request) -> web.Response:
+        agent_id = request.match_info["agent_id"]
+        self.s.manager.get_agent(agent_id)  # 404 check
+        status = request.query.get("status", RequestStatus.PENDING)
+        reqs = self.s.journal.by_status(agent_id, status)
+        return ok(
+            {
+                "requests": [r.to_dict() for r in reqs],
+                "stats": self.s.journal.stats(agent_id),
+            }
+        )
+
+    async def h_manual_replay(self, request: web.Request) -> web.Response:
+        """Manual single-request replay (server.go:681-751)."""
+        agent_id = request.match_info["agent_id"]
+        request_id = request.match_info["request_id"]
+        req = self.s.journal.get(agent_id, request_id)
+        if req is None:
+            return fail("request not found", status=404)
+        status, _, body = await self.dispatch_to_agent(
+            agent_id, req.method, req.path, req.headers, req.body, request_id=request_id
+        )
+        self._audit(request, "replay", f"{agent_id}/{request_id}", "success")
+        return ok(
+            {"request_id": request_id, "status_code": status, "body": body.decode("utf-8", "replace")},
+            message="Request replayed",
+        )
+
+    async def h_agent_health(self, request: web.Request) -> web.Response:
+        agent_id = request.match_info["agent_id"]
+        self.s.manager.get_agent(agent_id)
+        return ok(self.s.health.get_status(agent_id))
+
+    async def h_agent_metrics(self, request: web.Request) -> web.Response:
+        agent_id = request.match_info["agent_id"]
+        self.s.manager.get_agent(agent_id)
+        return ok(self.s.metrics.current(agent_id))
+
+    async def h_agent_metrics_history(self, request: web.Request) -> web.Response:
+        agent_id = request.match_info["agent_id"]
+        self.s.manager.get_agent(agent_id)
+        since = float(request.query.get("since", time.time() - 3600))
+        until = float(request.query.get("until", time.time()))
+        return ok(self.s.metrics.history(agent_id, since, until))
+
+    async def h_all_metrics(self, request: web.Request) -> web.Response:
+        out = {}
+        for agent_id in self.s.manager.agent_ids():
+            out[agent_id] = self.s.metrics.current(agent_id)
+        return ok(out)
+
+    async def h_get_logs(self, request: web.Request) -> web.Response:
+        q = request.query
+        return ok(
+            self.s.logs.get_logs(
+                level=q.get("level", ""),
+                component=q.get("component", ""),
+                agent_id=q.get("agent", ""),
+                limit=int(q.get("limit", "100")),
+            )
+        )
+
+    async def h_get_audit(self, request: web.Request) -> web.Response:
+        q = request.query
+        return ok(
+            self.s.logs.get_audit(
+                user=q.get("user", ""),
+                action=q.get("action", ""),
+                resource=q.get("resource", ""),
+                limit=int(q.get("limit", "100")),
+            )
+        )
+
+    async def h_slice(self, request: web.Request) -> web.Response:
+        topo = self.s.scheduler.topology
+        return ok(
+            {
+                "topology": {
+                    "name": topo.name,
+                    "total_chips": topo.total_chips,
+                    "hbm_per_chip": topo.hbm_per_chip,
+                },
+                "placements": [p.to_dict() for p in self.s.scheduler.placements()],
+                "free_hbm": self.s.scheduler.free_hbm(),
+            }
+        )
+
+    # -- internal store API for engine subprocesses -----------------------
+    async def h_internal_store(self, request: web.Request) -> web.Response:
+        """Store access for engine processes.
+
+        The reference's agents talk to Redis directly over the Docker bridge
+        (examples/gpt-agent/app.py:20-27); here engines reach the daemon's
+        store through this endpoint. Each engine authenticates with its own
+        per-engine token (minted at engine creation, never the admin token)
+        and is namespaced to its agent's ``agent:{id}:*`` keys, so one agent
+        can neither read another's state nor call the management API.
+        """
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return fail("invalid JSON", status=400)
+        agent_id = request.headers.get("X-Agentainer-Agent-ID", "")
+        presented = request.headers.get("Authorization", "").removeprefix("Bearer ").strip()
+        expected = self.s.store.get(Keys.internal_token(agent_id)) if agent_id else None
+        import hmac as _hmac
+
+        if not agent_id or expected is None or not _hmac.compare_digest(
+            presented.encode(), expected
+        ):
+            return fail("invalid engine credentials", status=401)
+        op = body.get("op", "")
+        key = body.get("key", "")
+        if not key.startswith(f"agent:{agent_id}:"):
+            return fail("key outside agent namespace", status=403)
+        store = self.s.store
+        try:
+            if op == "get":
+                raw = store.get(key)
+                return ok(None if raw is None else raw.decode("utf-8", "replace"))
+            if op == "set":
+                store.set(key, body.get("value", ""), ttl=body.get("ttl"))
+                return ok()
+            if op == "set_b64":
+                import base64 as _b64
+
+                store.set(key, _b64.b64decode(body.get("value_b64", "")), ttl=body.get("ttl"))
+                return ok()
+            if op == "get_b64":
+                import base64 as _b64
+
+                raw = store.get(key)
+                return ok(None if raw is None else _b64.b64encode(raw).decode())
+            if op == "delete":
+                return ok(store.delete(key))
+            if op == "rpush":
+                return ok(store.rpush(key, *[v for v in body.get("values", [])]))
+            if op == "lrange":
+                return ok(store.lrange_str(key, body.get("start", 0), body.get("stop", -1)))
+            if op == "ltrim":
+                store.ltrim(key, body.get("start", 0), body.get("stop", -1))
+                return ok()
+            if op == "llen":
+                return ok(store.llen(key))
+            if op == "hincrby":
+                return ok(store.hincrby(key, body.get("field", ""), body.get("amount", 1)))
+            if op == "hgetall":
+                return ok({k: v.decode("utf-8", "replace") for k, v in store.hgetall(key).items()})
+            if op == "keys":
+                pat = body.get("pattern", key + "*")
+                if not pat.startswith(f"agent:{agent_id}:"):
+                    return fail("pattern outside agent namespace", status=403)
+                return ok(store.keys(pat))
+            return fail(f"unknown op {op!r}", status=400)
+        except TypeError as e:
+            return fail(str(e), status=400)
+
+    # -- backups ---------------------------------------------------------
+    async def h_backup_create(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            body = {}
+        backup = await self._mgr(
+            self.s.backups.create, body.get("name", ""), body.get("description", "")
+        )
+        self._audit(request, "backup-create", backup["id"], "success")
+        return ok(backup, message="Backup created")
+
+    async def h_backup_list(self, request: web.Request) -> web.Response:
+        return ok(await self._mgr(self.s.backups.list))
+
+    async def h_backup_restore(self, request: web.Request) -> web.Response:
+        backup_id = request.match_info["backup_id"]
+        restored = await self._mgr(self.s.backups.restore, backup_id)
+        self._audit(request, "backup-restore", backup_id, "success")
+        return ok(restored, message="Backup restored")
+
+    async def h_backup_delete(self, request: web.Request) -> web.Response:
+        backup_id = request.match_info["backup_id"]
+        await self._mgr(self.s.backups.delete, backup_id)
+        self._audit(request, "backup-delete", backup_id, "success")
+        return ok(message="Backup deleted")
+
+    # -- the proxy data path (server.go:493-615) -------------------------
+    async def h_proxy(self, request: web.Request) -> web.Response:
+        agent_id = request.match_info["agent_id"]
+        tail = request.match_info.get("tail", "")
+        path = "/" + tail if not tail.startswith("/") else tail
+        if request.query_string:
+            path = f"{path}?{request.query_string}"
+        body = await request.read()
+        headers = {k: v for k, v in request.headers.items() if k.lower() not in _HOP_BY_HOP}
+
+        try:
+            agent = self.s.manager.get_agent(agent_id)
+        except AgentNotFound:
+            return fail(f"agent not found: {agent_id}", status=404)
+
+        is_replay = request.headers.get(REPLAY_HEADER, "").lower() == "true"
+        request_id = request.headers.get(REQUEST_ID_HEADER, "")
+
+        persist = self.s.config.features.request_persistence
+        if persist and not is_replay:
+            journaled = self.s.journal.store_request(
+                agent_id, request.method, path, headers, body
+            )
+            request_id = journaled.id
+
+        if agent.status != AgentStatus.RUNNING:
+            if persist:
+                # "agent down → 202 + queue for replay" (server.go:525-541)
+                return ok(
+                    {"request_id": request_id, "status": "pending"},
+                    message="Agent is not running. Request queued and will be "
+                    "replayed when the agent is back.",
+                    status=202,
+                )
+            return fail("agent is not running", status=503)
+
+        status, resp_headers, resp_body = await self.dispatch_to_agent(
+            agent_id, request.method, path, headers, body, request_id=request_id
+        )
+        if status == DISPATCH_ENGINE_GONE:
+            # connection-level failure: the crash heuristic leaves the request
+            # pending for the replay worker (server.go:597-606)
+            return fail("agent unreachable; request left pending for replay", status=502)
+        if status == DISPATCH_FAILED:
+            # non-crash failure (timeout, protocol error): retry accounting
+            # ran; the entry dead-letters after MAX_RETRIES
+            return fail("agent request failed; retry recorded", status=504)
+        return web.Response(
+            status=status,
+            body=resp_body,
+            headers={
+                k: v
+                for k, v in resp_headers.items()
+                if k.lower() not in _HOP_BY_HOP and k.lower() != "content-type"
+            },
+            content_type=(resp_headers.get("Content-Type", "application/octet-stream").split(";")[0]),
+        )
+
+    async def dispatch_to_agent(
+        self,
+        agent_id: str,
+        method: str,
+        path: str,
+        headers: dict[str, str],
+        body: bytes,
+        request_id: str = "",
+    ) -> tuple[int, dict[str, str], bytes]:
+        """Forward to the engine and settle the journal entry.
+
+        Outcome classification mirrors the reference's interceptTransport
+        (server.go:583-615) with the journal entry's lifecycle made explicit:
+
+        - before dispatch the entry flips to PROCESSING so a racing replay
+          pass cannot execute it twice;
+        - success → COMPLETED with the archived response;
+        - connection-level failure (engine gone ↔ connection refused) →
+          back to PENDING, no retry charged; returns DISPATCH_ENGINE_GONE;
+        - timeout / protocol error → retry-count++ via mark_failed (dead-
+          letters after MAX_RETRIES); returns DISPATCH_FAILED. The reference
+          misclassifies slow responses as crashes, replaying them forever.
+        """
+        agent = self.s.manager.get_agent(agent_id)
+        endpoint = self.s.manager.endpoint(agent)
+        if endpoint is None:
+            return DISPATCH_ENGINE_GONE, {}, b""
+        if request_id:
+            self.s.journal.mark_processing(agent_id, request_id)
+
+        if endpoint.startswith("fake://"):
+            # in-process dispatch for the unit-test backend
+            handler = getattr(self.s.backend, "handle_request", None)
+            if handler is None:
+                if request_id:
+                    self.s.journal.mark_pending(agent_id, request_id)
+                return DISPATCH_ENGINE_GONE, {}, b""
+            try:
+                status, resp_headers, resp_body = handler(
+                    agent.engine_id, method, path, headers, body
+                )
+            except ConnectionError:
+                if request_id:
+                    self.s.journal.mark_pending(agent_id, request_id)
+                return DISPATCH_ENGINE_GONE, {}, b""
+            if request_id:
+                self.s.journal.store_response(agent_id, request_id, status, resp_headers, resp_body)
+            self.s.metrics.count_request(agent_id)
+            return status, resp_headers, resp_body
+
+        url = endpoint.rstrip("/") + path
+        fwd_headers = dict(headers)
+        fwd_headers.pop("Authorization", None)
+        if request_id:
+            fwd_headers[REQUEST_ID_HEADER] = request_id
+        t0 = time.monotonic()
+        import aiohttp
+
+        try:
+            async with self._client.request(
+                method, url, headers=fwd_headers, data=body if body else None
+            ) as resp:
+                resp_body = await resp.read()
+                resp_headers = dict(resp.headers)
+        except (aiohttp.ClientConnectorError, ConnectionError) as e:
+            if request_id:
+                self.s.journal.mark_pending(agent_id, request_id)
+            return DISPATCH_ENGINE_GONE, {}, b""
+        except (asyncio.TimeoutError, aiohttp.ClientError, OSError) as e:
+            if request_id:
+                self.s.journal.mark_failed(agent_id, request_id, f"{type(e).__name__}: {e}")
+            return DISPATCH_FAILED, {}, b""
+        if request_id:
+            self.s.journal.store_response(
+                agent_id, request_id, resp.status, resp_headers, resp_body
+            )
+        self.s.metrics.count_request(agent_id, latency_s=time.monotonic() - t0)
+        return resp.status, resp_headers, resp_body
+
+
+def create_app(services: "Services") -> web.Application:
+    return ControlPlaneApp(services).app

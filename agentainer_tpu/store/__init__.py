@@ -1,0 +1,47 @@
+"""State store — the single source of truth for the control plane.
+
+The reference uses Redis for everything: agent records, request journal,
+health, metrics, logs, audit, and pub/sub eventing (reference
+internal/storage/storage.go:11-97 and the key schema spread across
+internal/agent/agent.go:510-592, internal/requests/requests.go:64-275,
+internal/health/monitor.go:267-270, pkg/metrics/collector.go:300-322,
+internal/logging/logger.go:323-349).
+
+This package defines a Store interface with exactly the operation surface the
+framework needs (strings+TTL, sets, lists, sorted sets, hashes, pattern
+pub/sub), an in-memory implementation (default — no external Redis required on
+a TPU-VM), and an optional native C++ implementation behind the same interface.
+The *key schema* is kept 1:1 with the reference (see schema.py) so that the
+data model survives the port even though the engine underneath changed.
+"""
+
+from .base import Store, Subscription
+from .memory import MemoryStore
+from .schema import Keys
+
+__all__ = ["Store", "Subscription", "MemoryStore", "Keys", "open_store"]
+
+
+def open_store(url: str | None = None) -> Store:
+    """Open a store from a URL.
+
+    ``mem://`` (default) → in-process MemoryStore;
+    ``native://`` → C++ store (falls back to MemoryStore if the shared
+    library has not been built);
+    ``redis://host:port`` → real Redis, if the ``redis`` package is present
+    (it is not baked into the TPU-VM image, so this is gated).
+    """
+    if not url or url.startswith("mem://"):
+        return MemoryStore()
+    if url.startswith("native://"):
+        try:
+            from .native import NativeStore
+
+            return NativeStore()
+        except Exception:
+            return MemoryStore()
+    if url.startswith("redis://"):
+        raise RuntimeError(
+            "redis-py is not available in this environment; use mem:// or native://"
+        )
+    raise ValueError(f"unknown store url: {url}")

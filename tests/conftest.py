@@ -1,0 +1,41 @@
+"""Test harness config.
+
+Multi-chip logic is tested without TPU hardware: force the JAX CPU platform
+and fake 8 host devices so `jax.sharding.Mesh` tests exercise real SPMD
+partitioning + collectives (the TPU-world analogue of the reference's
+"single host by design, no multi-node tests" gap — SURVEY.md §4).
+
+This must run before anything imports jax, hence conftest top-level.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import socket  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def store():
+    from agentainer_tpu.store import MemoryStore
+
+    s = MemoryStore()
+    yield s
+    s.close()
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture
+def port() -> int:
+    return free_port()

@@ -1,0 +1,88 @@
+"""Request journal unit tests: TTL, retry accounting, dead-lettering
+(reference requests.go:64-275 semantics)."""
+
+import time
+
+from agentainer_tpu.manager.journal import RequestJournal, RequestStatus
+from agentainer_tpu.store import Keys, MemoryStore
+
+
+def make():
+    store = MemoryStore()
+    return store, RequestJournal(store)
+
+
+def test_store_and_complete():
+    store, j = make()
+    req = j.store_request("a1", "POST", "/chat", {"X": "1"}, b'{"m":1}')
+    assert j.pending_ids("a1") == [req.id]
+    got = j.get("a1", req.id)
+    assert got.body == b'{"m":1}'
+    assert got.headers == {"X": "1"}
+
+    j.store_response("a1", req.id, 200, {"Content-Type": "application/json"}, b"ok")
+    assert j.pending_ids("a1") == []
+    assert j.stats("a1") == {"pending": 0, "completed": 1, "failed": 0}
+    done = j.get("a1", req.id)
+    assert done.status == RequestStatus.COMPLETED
+    assert done.response["status_code"] == 200
+
+
+def test_retry_then_dead_letter():
+    store, j = make()
+    req = j.store_request("a1", "POST", "/chat", body=b"x")
+    # failures below the cap keep it pending (requests.go:228-275)
+    j.mark_failed("a1", req.id, "boom-1")
+    assert j.get("a1", req.id).retry_count == 1
+    assert j.pending_ids("a1") == [req.id]
+    j.mark_failed("a1", req.id, "boom-2")
+    assert j.pending_ids("a1") == [req.id]
+    # third failure dead-letters
+    j.mark_failed("a1", req.id, "boom-3")
+    assert j.pending_ids("a1") == []
+    assert j.stats("a1")["failed"] == 1
+    dead = j.get("a1", req.id)
+    assert dead.status == RequestStatus.FAILED
+    assert dead.error == "boom-3"
+
+
+def test_record_ttl_applied():
+    store, j = make()
+    req = j.store_request("a1", "GET", "/x")
+    ttl = store.ttl(Keys.request("a1", req.id))
+    assert ttl is not None and ttl > 23 * 3600
+
+
+def test_ttl_not_reset_on_touch():
+    store = MemoryStore()
+    j = RequestJournal(store, ttl_s=100.0)
+    req = j.store_request("a1", "GET", "/x")
+    time.sleep(0.05)
+    j.mark_failed("a1", req.id, "e")
+    ttl = store.ttl(Keys.request("a1", req.id))
+    assert ttl is not None and ttl < 100.0
+
+
+def test_expired_record_pruned_from_pending():
+    store = MemoryStore()
+    j = RequestJournal(store, ttl_s=0.01)
+    j.store_request("a1", "GET", "/x")
+    time.sleep(0.03)
+    assert j.pending("a1") == []
+    assert store.llen(Keys.pending("a1")) == 0
+
+
+def test_agents_with_pending():
+    store, j = make()
+    j.store_request("a1", "GET", "/x")
+    j.store_request("a2", "GET", "/y")
+    r3 = j.store_request("a3", "GET", "/z")
+    j.store_response("a3", r3.id, 200)
+    assert sorted(j.agents_with_pending()) == ["a1", "a2"]
+
+
+def test_idempotency_key_roundtrip():
+    store, j = make()
+    req = j.store_request("a1", "POST", "/chat", request_id="fixed-id")
+    assert req.id == "fixed-id"
+    assert j.get("a1", "fixed-id") is not None

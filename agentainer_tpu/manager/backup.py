@@ -93,24 +93,34 @@ class BackupManager:
             raise InvalidInput(f"backup not found: {backup_id}")
         manifest = json.loads(path.read_text())
         restored = []
+        errors = []
         for record in manifest.get("agents", []):
-            old = Agent.from_dict(record)
-            agent = self.manager.deploy(
-                name=f"{old.name}-restored",  # manager.go:156-191 parity
-                model=old.model,
-                env=old.env,
-                resources=old.resources,
-                auto_restart=old.auto_restart,
-                token=old.token,
-                health_check=old.health_check,
-            )
-            state = manifest.get("app_state", {}).get(old.id, {})
-            for line in state.get("conversations", []):
-                self.store.rpush(Keys.conversations(agent.id), line)
-            for key, blob_b64 in state.get("kvcache", {}).items():
-                session = key.rsplit(":", 1)[-1]
-                self.store.set(Keys.kvcache(agent.id, session), base64.b64decode(blob_b64))
-            restored.append(agent.to_dict())
+            try:
+                old = Agent.from_dict(record)
+                suffix = "-restored"  # manager.go:156-191 parity
+                name = old.name[: 64 - len(suffix)] + suffix  # respect deploy's 64-char cap
+                agent = self.manager.deploy(
+                    name=name,
+                    model=old.model,
+                    env=old.env,
+                    resources=old.resources,
+                    auto_restart=old.auto_restart,
+                    token=old.token,
+                    health_check=old.health_check,
+                )
+                state = manifest.get("app_state", {}).get(old.id, {})
+                for line in state.get("conversations", []):
+                    self.store.rpush(Keys.conversations(agent.id), line)
+                for key, blob_b64 in state.get("kvcache", {}).items():
+                    session = key.rsplit(":", 1)[-1]
+                    self.store.set(Keys.kvcache(agent.id, session), base64.b64decode(blob_b64))
+                restored.append(agent.to_dict())
+            except Exception as e:  # one bad record must not abort the rest
+                errors.append({"agent": record.get("name", "?"), "error": str(e)})
+        if errors and not restored:
+            raise InvalidInput(f"restore failed for all agents: {errors}")
+        for err in errors:
+            restored.append({"restore_error": err})
         return restored
 
     def delete(self, backup_id: str) -> None:

@@ -118,7 +118,7 @@ class HealthMonitor:
                 self.dispatch(agent_id, "GET", cfg.endpoint, {}, b"", request_id=""),
                 timeout=cfg.timeout_s,
             )
-        except (asyncio.TimeoutError, Exception):
+        except Exception:
             return False
         return 200 <= status < 300
 

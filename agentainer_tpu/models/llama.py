@@ -1,0 +1,227 @@
+"""Llama-3-family transformer — functional JAX, TPU-first.
+
+Green-field (the reference proxies to external LLM APIs and has no model
+code — SURVEY.md §2.3); this is the in-process engine's model, designed for
+XLA from the start:
+
+- **pytree params with stacked layers**: every per-layer weight carries a
+  leading ``[n_layers, ...]`` axis and the forward pass is one
+  ``lax.scan`` over layers — one traced block regardless of depth (fast
+  compiles, and the natural substrate for pipeline parallelism later);
+- **static shapes everywhere**: the KV cache is a fixed ``[L, B, S, KV, hd]``
+  arena written by scatter at per-sequence positions, so the same compiled
+  function serves prefill and continuous-batching decode (ragged batches);
+- **bf16 weights/activations, f32 softmax/norms** — MXU-friendly;
+- GQA grouping instead of repeated K/V (HBM bandwidth);
+- sharding-agnostic: parallel/sharding.py maps these pytree paths to mesh
+  axes; nothing here names a device.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.attention import attention_reference, cache_mask, causal_mask, flash_attention
+from ..ops.norms import rms_norm
+from ..ops.rope import apply_rope
+from .configs import ModelConfig
+
+
+class KVCache(NamedTuple):
+    """Static-shape KV arena: k/v ``[L, B, S, KV, hd]``."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+
+    @staticmethod
+    def create(
+        cfg: ModelConfig, batch: int, max_seq: int, dtype: jnp.dtype = jnp.bfloat16
+    ) -> "KVCache":
+        shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
+        return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype: jnp.dtype = jnp.bfloat16) -> dict:
+    """Random init (truncated-normal-ish 0.02 scale). Checkpoint loading maps
+    onto the same pytree (engine/checkpoint.py)."""
+    keys = iter(jax.random.split(key, 16))
+    d, hd = cfg.dim, cfg.head_dim
+
+    def w(k, *shape, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    layers = {
+        "attn_norm": jnp.ones((cfg.n_layers, d), dtype),
+        "wq": w(next(keys), cfg.n_layers, d, cfg.n_heads * hd),
+        "wk": w(next(keys), cfg.n_layers, d, cfg.n_kv_heads * hd),
+        "wv": w(next(keys), cfg.n_layers, d, cfg.n_kv_heads * hd),
+        "wo": w(next(keys), cfg.n_layers, cfg.n_heads * hd, d),
+        "mlp_norm": jnp.ones((cfg.n_layers, d), dtype),
+    }
+    if cfg.is_moe:
+        layers.update(
+            {
+                "router": w(next(keys), cfg.n_layers, d, cfg.n_experts),
+                "w_gate": w(next(keys), cfg.n_layers, cfg.n_experts, d, cfg.ffn_dim),
+                "w_up": w(next(keys), cfg.n_layers, cfg.n_experts, d, cfg.ffn_dim),
+                "w_down": w(next(keys), cfg.n_layers, cfg.n_experts, cfg.ffn_dim, d),
+            }
+        )
+    else:
+        layers.update(
+            {
+                "w_gate": w(next(keys), cfg.n_layers, d, cfg.ffn_dim),
+                "w_up": w(next(keys), cfg.n_layers, d, cfg.ffn_dim),
+                "w_down": w(next(keys), cfg.n_layers, cfg.ffn_dim, d),
+            }
+        )
+    return {
+        "embed": w(next(keys), cfg.vocab_size, d),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), dtype),
+        "lm_head": w(next(keys), d, cfg.vocab_size),
+    }
+
+
+def _mlp(x: jnp.ndarray, lp: dict) -> jnp.ndarray:
+    """SwiGLU."""
+    gate = jax.nn.silu(x @ lp["w_gate"])
+    return (gate * (x @ lp["w_up"])) @ lp["w_down"]
+
+
+def _moe_mlp(x: jnp.ndarray, lp: dict, cfg: ModelConfig) -> jnp.ndarray:
+    """Dense-einsum MoE (top-k routing, all experts computed, masked combine).
+
+    On a single chip the dense form keeps the MXU busy with one big einsum
+    instead of gather/scatter; the expert-parallel path (parallel/expert.py)
+    shards the expert axis over the mesh and turns the combine into
+    all-to-alls on ICI.
+    """
+    b, t, d = x.shape
+    logits = x @ lp["router"]  # [B,T,E]
+    weights, chosen = lax.top_k(logits, cfg.experts_per_token)
+    weights = jax.nn.softmax(weights.astype(jnp.float32), axis=-1).astype(x.dtype)
+    onehot = jax.nn.one_hot(chosen, cfg.n_experts, dtype=x.dtype)  # [B,T,K,E]
+    combine = jnp.einsum("btk,btke->bte", weights, onehot)  # [B,T,E]
+    gate = jax.nn.silu(jnp.einsum("btd,edf->btef", x, lp["w_gate"]))
+    up = jnp.einsum("btd,edf->btef", x, lp["w_up"])
+    expert_out = jnp.einsum("btef,efd->bted", gate * up, lp["w_down"])
+    return jnp.einsum("bted,bte->btd", expert_out, combine)
+
+
+def _attention_block(
+    x: jnp.ndarray,
+    lp: dict,
+    cfg: ModelConfig,
+    positions: jnp.ndarray,
+    mask: jnp.ndarray,
+    ck: jnp.ndarray | None,
+    cv: jnp.ndarray | None,
+    use_flash: bool,
+):
+    b, t, d = x.shape
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(b, t, cfg.n_heads, cfg.head_dim)
+    k = (h @ lp["wk"]).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+    v = (h @ lp["wv"]).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+
+    if ck is not None:
+        # scatter this step's K/V into the arena at per-sequence positions
+        batch_idx = jnp.arange(b)[:, None]
+        ck = ck.at[batch_idx, positions].set(k)
+        cv = cv.at[batch_idx, positions].set(v)
+        attn = attention_reference(q, ck, cv, mask=mask)
+    elif use_flash:
+        attn = flash_attention(q, k, v, mask=mask)
+    else:
+        attn = attention_reference(q, k, v, mask=mask)
+    out = attn.reshape(b, t, cfg.n_heads * cfg.head_dim) @ lp["wo"]
+    return x + out, ck, cv
+
+
+def forward(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, T] int32
+    positions: jnp.ndarray,  # [B, T] int32
+    cache: KVCache | None = None,
+    use_flash: bool = True,
+) -> tuple[jnp.ndarray, KVCache | None]:
+    """Returns (logits [B, T, V], updated cache).
+
+    With a cache: serves prefill (T = prompt chunk) and decode (T = 1) with
+    per-sequence positions — the continuous-batching engine relies on this.
+    Without: pure causal self-attention (training / eval).
+    """
+    x = params["embed"][tokens].astype(params["embed"].dtype)
+    if cache is not None:
+        mask = cache_mask(positions, cache.k.shape[2])  # [B, T, S]
+    else:
+        t = tokens.shape[1]
+        mask = jnp.broadcast_to(causal_mask(t), (tokens.shape[0], t, t))
+
+    lp_stack = params["layers"]
+
+    def layer_step(carry, inputs):
+        x = carry
+        if cache is not None:
+            lp, ck, cv = inputs
+            x, ck, cv = _attention_block(x, lp, cfg, positions, mask, ck, cv, use_flash)
+        else:
+            lp = inputs
+            x, _, _ = _attention_block(x, lp, cfg, positions, mask, None, None, use_flash)
+            ck = cv = jnp.zeros((0,), x.dtype)  # scan needs a leaf
+        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        if cfg.is_moe:
+            x = x + _moe_mlp(h, lp, cfg)
+        else:
+            x = x + _mlp(h, lp)
+        return x, (ck, cv)
+
+    if cache is not None:
+        x, (new_k, new_v) = lax.scan(layer_step, x, (lp_stack, cache.k, cache.v))
+        new_cache = KVCache(k=new_k, v=new_v)
+    else:
+        x, _ = lax.scan(layer_step, x, lp_stack)
+        new_cache = None
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, new_cache
+
+
+def greedy_decode(
+    params: dict,
+    cfg: ModelConfig,
+    prompt: jnp.ndarray,  # [B, Tp]
+    max_new_tokens: int,
+    cache_len: int,
+    dtype: jnp.dtype = jnp.bfloat16,
+) -> jnp.ndarray:
+    """Reference generation loop: prefill then a ``lax.scan`` decode.
+    Engine-grade batching lives in engine/llm.py; this is the simple path
+    used by tests and the graft entry."""
+    b, tp = prompt.shape
+    cache = KVCache.create(cfg, b, cache_len, dtype=dtype)
+    positions = jnp.broadcast_to(jnp.arange(tp), (b, tp))
+    logits, cache = forward(params, cfg, prompt, positions, cache)
+    last = jnp.argmax(logits[:, -1], axis=-1)  # [B]
+
+    def step(carry, i):
+        cache, tok, pos = carry
+        logits, cache = forward(
+            params, cfg, tok[:, None], pos[:, None], cache
+        )
+        nxt = jnp.argmax(logits[:, -1], axis=-1)
+        return (cache, nxt, pos + 1), nxt
+
+    (_, _, _), toks = lax.scan(
+        step, (cache, last, jnp.full((b,), tp)), jnp.arange(max_new_tokens - 1)
+    )
+    return jnp.concatenate([last[:, None], toks.T], axis=1)  # [B, max_new_tokens]

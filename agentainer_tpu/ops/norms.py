@@ -1,0 +1,11 @@
+"""Normalization ops. RMSNorm in float32 accumulation (bf16 inputs)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    rms = jnp.sqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return ((xf / rms) * weight.astype(jnp.float32)).astype(x.dtype)

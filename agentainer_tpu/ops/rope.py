@@ -1,0 +1,33 @@
+"""Rotary position embeddings (RoPE), Llama-3 style.
+
+No reference counterpart (the reference has no model code, SURVEY.md §2.3);
+this is green-field TPU-first design: pure functions of (x, positions) with
+static shapes so XLA fuses the rotation into the surrounding matmuls, and a
+split-half rotation layout (rotate_half) matching Llama's convention.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, theta: float) -> jnp.ndarray:
+    """Inverse frequencies, shape [head_dim // 2], float32."""
+    exponents = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return 1.0 / (theta**exponents)
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
+    """Rotate ``x`` [..., T, n_heads, head_dim] by per-token ``positions`` [..., T].
+
+    Computed in float32 regardless of input dtype (bf16 angles lose precision
+    at long context), cast back on return.
+    """
+    head_dim = x.shape[-1]
+    freqs = rope_frequencies(head_dim, theta)  # [hd/2]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [..., T, hd/2]
+    cos = jnp.cos(angles)[..., None, :]  # [..., T, 1, hd/2]
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return rotated.astype(x.dtype)

@@ -1,0 +1,54 @@
+"""Device mesh construction.
+
+The TPU-native replacement for the reference's "distribution" layer (Docker
+bridge + replicas, SURVEY.md §2.3): parallelism here is a
+``jax.sharding.Mesh`` over the chips the slice scheduler assigned, with
+named axes
+
+    dp  — data parallel (replica fan-out, the reference's ``replicas: N``)
+    tp  — tensor parallel (attention heads / FFN width over ICI)
+    sp  — sequence/context parallel (ring attention / Ulysses)
+    ep  — expert parallel (MoE all-to-all)
+
+Axis sizes are chosen to divide the model's head/expert counts; XLA/GSPMD
+inserts the all-gathers/reduce-scatters implied by the sharding annotations
+(parallel/sharding.py) so collectives ride ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..models.configs import ModelConfig
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    tp: int = 1,
+    sp: int = 1,
+    ep: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """Mesh with axes (dp, tp, sp, ep); dp absorbs the remaining devices."""
+    devs = devices if devices is not None else jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    n = len(devs)
+    denom = tp * sp * ep
+    if n % denom != 0:
+        raise ValueError(f"{n} devices not divisible by tp*sp*ep={denom}")
+    dp = n // denom
+    arr = np.array(devs).reshape(dp, tp, sp, ep)
+    return Mesh(arr, axis_names=("dp", "tp", "sp", "ep"))
+
+
+def pick_tp(cfg: ModelConfig, n_devices: int) -> int:
+    """Largest tp that divides both the device count and the model's KV-head
+    count (GQA shards KV heads; tp beyond n_kv_heads would split a head)."""
+    tp = 1
+    for cand in range(1, n_devices + 1):
+        if n_devices % cand == 0 and cfg.n_kv_heads % cand == 0 and cfg.n_heads % cand == 0:
+            tp = cand
+    return tp

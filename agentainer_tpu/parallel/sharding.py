@@ -1,0 +1,74 @@
+"""Sharding rules: pytree paths → PartitionSpecs.
+
+Megatron-style tensor parallelism expressed as GSPMD annotations (not
+hand-written collectives): column-parallel QKV/gate/up projections, row-
+parallel output/down projections, vocab-sharded embed/lm_head. XLA inserts
+the matching all-reduce/all-gather on ICI. Expert weights additionally
+shard their expert axis over ``ep`` (parallel/expert.py's all-to-all path).
+
+Batch/sequence activations shard over ``dp``/``sp``; everything else
+replicates. These specs feed ``jax.jit(in_shardings=...)`` /
+``jax.device_put`` — model code never names a device.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def param_specs(moe: bool) -> dict:
+    """PartitionSpec tree matching models/llama.init_params' structure."""
+    layers = {
+        "attn_norm": P(None, None),
+        "wq": P(None, None, "tp"),  # column-parallel: heads split over tp
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),  # row-parallel: all-reduce after
+        "mlp_norm": P(None, None),
+    }
+    if moe:
+        layers.update(
+            {
+                "router": P(None, None, None),
+                "w_gate": P(None, "ep", None, "tp"),
+                "w_up": P(None, "ep", None, "tp"),
+                "w_down": P(None, "ep", "tp", None),
+            }
+        )
+    else:
+        layers.update(
+            {
+                "w_gate": P(None, None, "tp"),
+                "w_up": P(None, None, "tp"),
+                "w_down": P(None, "tp", None),
+            }
+        )
+    return {
+        "embed": P("tp", None),  # vocab-sharded
+        "layers": layers,
+        "final_norm": P(None),
+        "lm_head": P(None, "tp"),
+    }
+
+
+def param_shardings(mesh: Mesh, moe: bool = False) -> dict:
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        param_specs(moe),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def batch_spec() -> P:
+    """Tokens/positions: batch over dp, sequence over sp."""
+    return P("dp", "sp")
+
+
+def cache_specs() -> P:
+    """KV cache [L, B, S, KV, hd]: batch over dp, heads over tp."""
+    return P(None, "dp", None, "tp", None)
+
+
+def shard_params(params: dict, mesh: Mesh, moe: bool = False) -> dict:
+    return jax.device_put(params, param_shardings(mesh, moe))

@@ -123,8 +123,11 @@ class LocalBackend(Backend):
             }
         )
         if agent.model.engine != "llm":
-            # non-TPU engines must not grab the TPU runtime
+            # non-TPU engines must not grab the TPU runtime — clear both the
+            # platform selector and the axon-tunnel trigger the TPU-VM image
+            # injects via sitecustomize
             env["JAX_PLATFORMS"] = "cpu"
+            env.pop("PALLAS_AXON_POOL_IPS", None)
         cmd = [self.python, "-m", "agentainer_tpu.runtime.engine_main"]
         rec = _EngineRec(
             engine_id=engine_id,
@@ -152,6 +155,11 @@ class LocalBackend(Backend):
         self._emit(engine_id, EngineState.RUNNING)
 
     def _spawn(self, rec: _EngineRec) -> None:
+        if rec.log_file is not None:  # respawn: don't leak the old handle
+            try:
+                rec.log_file.close()
+            except OSError:
+                pass
         rec.log_file = open(rec.log_path, "ab")
         rec.env["AGENTAINER_CONTROL_URL"] = self.control_url
         rec.proc = subprocess.Popen(

@@ -57,6 +57,9 @@ _HOP_BY_HOP = {
     "upgrade",
     "host",
     "content-length",
+    # aiohttp auto-decompresses upstream bodies; forwarding the original
+    # Content-Encoding would label a plain body as compressed
+    "content-encoding",
 }
 
 
@@ -109,9 +112,13 @@ class ControlPlaneApp:
         # /internal/store authenticates with per-engine tokens in its handler
         public = path == "/health" or path.startswith("/agent/") or path == "/internal/store"
         if not public:
+            import hmac as _hmac
+
             header = request.headers.get("Authorization", "")
             token = header.removeprefix("Bearer ").strip()
-            if not header.startswith("Bearer ") or token != self.s.config.auth_token:
+            if not header.startswith("Bearer ") or not _hmac.compare_digest(
+                token.encode(), self.s.config.auth_token.encode()
+            ):
                 self.s.logs.audit(
                     user="unknown",
                     action="auth",
@@ -253,6 +260,12 @@ class ControlPlaneApp:
         status, _, body = await self.dispatch_to_agent(
             agent_id, req.method, req.path, req.headers, req.body, request_id=request_id
         )
+        if status == DISPATCH_ENGINE_GONE:
+            self._audit(request, "replay", f"{agent_id}/{request_id}", "engine-unreachable")
+            return fail("agent unreachable; request left pending for replay", status=502)
+        if status == DISPATCH_FAILED:
+            self._audit(request, "replay", f"{agent_id}/{request_id}", "failed")
+            return fail("replay dispatch failed; retry recorded", status=504)
         self._audit(request, "replay", f"{agent_id}/{request_id}", "success")
         return ok(
             {"request_id": request_id, "status_code": status, "body": body.decode("utf-8", "replace")},
@@ -430,11 +443,18 @@ class ControlPlaneApp:
         except AgentNotFound:
             return fail(f"agent not found: {agent_id}", status=404)
 
-        is_replay = request.headers.get(REPLAY_HEADER, "").lower() == "true"
-        request_id = request.headers.get(REQUEST_ID_HEADER, "")
+        # The reference trusts X-Agentainer-Replay/-Request-ID from the
+        # network because its replay worker re-enters the proxy over HTTP
+        # (replay_worker.go:120-163) — which also lets any caller skip
+        # journaling or settle someone else's pending entry. Our replay
+        # dispatches in-process, so these headers are stripped as pure
+        # attack surface.
+        headers.pop(REPLAY_HEADER, None)
+        headers.pop(REQUEST_ID_HEADER, None)
 
+        request_id = ""
         persist = self.s.config.features.request_persistence
-        if persist and not is_replay:
+        if persist:
             journaled = self.s.journal.store_request(
                 agent_id, request.method, path, headers, body
             )

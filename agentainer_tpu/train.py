@@ -1,0 +1,90 @@
+"""Sharded training step — next-token LM loss over a (dp, tp, sp, ep) mesh.
+
+The reference has no training; agents there are frozen external APIs. Here
+agents are models the framework owns, so fine-tuning them in place is a
+framework feature — and this module is also the multi-chip contract the
+driver dry-runs (``__graft_entry__.dryrun_multichip``): params sharded per
+parallel/sharding.py, batch sharded over dp×sp, optimizer state sharded like
+the params, one jit containing forward, loss, backward, and the optax update
+— XLA/GSPMD inserts the gradient all-reduces over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .models.configs import ModelConfig
+from .models.llama import forward, init_params
+from .parallel.sharding import batch_spec, param_shardings
+
+
+class TrainState(NamedTuple):
+    params: dict
+    opt_state: Any
+    step: jnp.ndarray
+
+
+def loss_fn(params: dict, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Causal LM loss: predict tokens[:, 1:] from tokens[:, :-1]."""
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    positions = jnp.broadcast_to(jnp.arange(inputs.shape[1]), inputs.shape)
+    logits, _ = forward(params, cfg, inputs, positions, cache=None, use_flash=False)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def make_train_step(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    learning_rate: float = 3e-4,
+    weight_decay: float = 0.01,
+):
+    """Returns (init_fn, step_fn), both jitted with mesh shardings."""
+    tx = optax.adamw(learning_rate, weight_decay=weight_decay)
+    p_shard = param_shardings(mesh, moe=cfg.is_moe)
+    repl = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, batch_spec())
+
+    def step(state: TrainState, tokens: jnp.ndarray) -> tuple[TrainState, jnp.ndarray]:
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, cfg, tokens)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    # optimizer state mirrors param sharding; scalars replicate
+    def opt_shardings(opt_state):
+        def leaf_shard(leaf):
+            return repl
+
+        return jax.tree.map(leaf_shard, opt_state)
+
+    def init_sharded(key: jax.Array) -> TrainState:
+        params = jax.device_put(init_params(cfg, key, dtype=jnp.float32), p_shard)
+        # adamw moments are param-shaped: shard them like their params;
+        # scalar leaves (step counts) replicate
+        def place_momentlike(leaf):
+            if isinstance(leaf, dict) and set(leaf) == set(p_shard):
+                return jax.device_put(leaf, p_shard)
+            return jax.device_put(leaf, repl)
+
+        opt_state = jax.tree.map(
+            place_momentlike,
+            tx.init(params),
+            is_leaf=lambda x: isinstance(x, dict) and set(x) == set(p_shard),
+        )
+        return TrainState(params, opt_state, jax.device_put(jnp.zeros((), jnp.int32), repl))
+
+    # input shardings are inferred from the committed arrays; shard_batch
+    # places tokens over (dp, sp)
+    step_jit = jax.jit(step, donate_argnums=(0,))
+
+    def shard_batch(tokens: jnp.ndarray) -> jnp.ndarray:
+        return jax.device_put(tokens, data)
+
+    return init_sharded, step_jit, shard_batch

@@ -1,0 +1,107 @@
+"""Benchmark: gpt-agent /chat req/s through the full control plane.
+
+BASELINE.json config #1 — the mock-LLM echo agent behind the real stack:
+HTTP proxy + bearer-free agent path + request journal (persistence ON) +
+subprocess engine, end to end over real sockets. The reference's only
+throughput claim for this path is "thousands of requests/second" with
+~1-2 ms proxy overhead (docs/NETWORK_ARCHITECTURE.md:444-448); baseline is
+taken as 2000 req/s.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "req/s", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import statistics
+import sys
+import tempfile
+import time
+
+BASELINE_REQ_S = 2000.0
+N_REQUESTS = 600
+CONCURRENCY = 64
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+async def run_bench() -> dict:
+    import aiohttp
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from agentainer_tpu.config import Config
+    from agentainer_tpu.daemon import build_services
+    from agentainer_tpu.runtime.local import LocalBackend
+
+    tmp = tempfile.mkdtemp(prefix="atpu-bench-")
+    cfg = Config()
+    cfg.auth_token = "bench-token"
+    backend = LocalBackend(data_dir=tmp, ready_timeout_s=60.0)
+    services = build_services(
+        config=cfg, backend=backend, console_logs=False, data_dir=tmp
+    )
+    client = TestClient(TestServer(services.app))
+    await client.start_server()
+    backend.set_control(f"http://127.0.0.1:{client.server.port}")
+    auth = {"Authorization": "Bearer bench-token"}
+
+    resp = await client.post("/agents", json={"name": "bench-echo", "model": "echo"}, headers=auth)
+    agent = (await resp.json())["data"]
+    resp = await client.post(f"/agents/{agent['id']}/start", headers=auth)
+    assert resp.status == 200, await resp.text()
+    log(f"agent {agent['id']} running")
+
+    url = f"/agent/{agent['id']}/chat"
+    sem = asyncio.Semaphore(CONCURRENCY)
+    latencies: list[float] = []
+
+    async def one(i: int) -> None:
+        async with sem:
+            t0 = time.monotonic()
+            async with client.post(url, data=json.dumps({"message": f"bench {i}"})) as r:
+                await r.read()
+                assert r.status == 200, r.status
+            latencies.append(time.monotonic() - t0)
+
+    # warmup
+    await asyncio.gather(*(one(i) for i in range(32)))
+    latencies.clear()
+
+    t0 = time.monotonic()
+    await asyncio.gather(*(one(i) for i in range(N_REQUESTS)))
+    wall = time.monotonic() - t0
+
+    stats = services.journal.stats(agent["id"])
+    log(f"journal stats: {stats}")
+    assert stats["failed"] == 0
+
+    backend.close()
+    await client.close()
+
+    reqps = N_REQUESTS / wall
+    return {
+        "metric": "gpt_agent_chat_req_per_s_e2e_journaled",
+        "value": round(reqps, 1),
+        "unit": "req/s",
+        "vs_baseline": round(reqps / BASELINE_REQ_S, 3),
+        "extra": {
+            "p50_ms": round(1000 * statistics.median(latencies), 2),
+            "p99_ms": round(1000 * sorted(latencies)[int(0.99 * len(latencies))], 2),
+            "n": N_REQUESTS,
+            "concurrency": CONCURRENCY,
+            "journaled": True,
+        },
+    }
+
+
+def main() -> None:
+    result = asyncio.run(run_bench())
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

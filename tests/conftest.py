@@ -10,11 +10,19 @@ This must run before anything imports jax, hence conftest top-level.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the TPU-VM image pre-sets JAX_PLATFORMS=axon (the
+# tunnel to the real chip) and its sitecustomize imports jax at interpreter
+# startup, so the env var alone is too late — jax.config.update below is what
+# actually pins the platform. Unit tests must stay on the CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import socket  # noqa: E402
 

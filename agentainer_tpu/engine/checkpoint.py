@@ -1,0 +1,77 @@
+"""Checkpoint plane: model weights + KV-cache snapshots.
+
+Three tiers, mirroring and upgrading the reference's checkpoint story
+(SURVEY.md §5.4: agent records in Redis, backup tarballs, in-agent
+checkpoint patterns):
+
+- **weights**: orbax PyTree checkpoints under a directory; ``load_params``
+  restores into the model's pytree with the engine's dtype;
+- **KV snapshots**: a single cache *slot* (one session's context) serialized
+  to bytes for the store — this is what lets a restarted engine resume a
+  conversation without re-prefilling (BASELINE.json config #3);
+- agent records/backups live in the control plane (manager/backup.py).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.configs import ModelConfig
+from ..models.llama import KVCache
+
+
+def save_params(params: dict, path: str | Path) -> None:
+    import orbax.checkpoint as ocp
+
+    path = Path(path).expanduser().resolve()
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(path / "params", jax.device_get(params))
+
+
+def load_params(cfg: ModelConfig, path: str | Path, dtype=jnp.bfloat16) -> dict:
+    import orbax.checkpoint as ocp
+
+    path = Path(path).expanduser().resolve()
+    ckptr = ocp.PyTreeCheckpointer()
+    restored = ckptr.restore(path / "params")
+    return jax.tree.map(lambda x: jnp.asarray(x, dtype), restored)
+
+
+# -- KV slot snapshots (engine ↔ store) ---------------------------------
+SNAP_VERSION = 1
+
+
+def serialize_kv_slot(cache: KVCache, slot: int, position: int, meta: dict | None = None) -> bytes:
+    """Pack one slot's live KV prefix ([L, position, KV, hd] per k/v) into a
+    self-describing npz blob. Only the written prefix ships — a 100-token
+    conversation snapshot is ~100/S of the slot arena."""
+    k = np.asarray(cache.k[:, slot, :position].astype(jnp.float16))
+    v = np.asarray(cache.v[:, slot, :position].astype(jnp.float16))
+    buf = io.BytesIO()
+    header = json.dumps({"version": SNAP_VERSION, "position": position, **(meta or {})})
+    np.savez_compressed(buf, k=k, v=v, header=np.frombuffer(header.encode(), dtype=np.uint8))
+    return buf.getvalue()
+
+
+def deserialize_kv_slot(blob: bytes) -> tuple[np.ndarray, np.ndarray, dict]:
+    """Returns (k [L, pos, KV, hd], v, header dict)."""
+    with np.load(io.BytesIO(blob)) as z:
+        header = json.loads(bytes(z["header"]).decode())
+        if header.get("version") != SNAP_VERSION:
+            raise ValueError(f"unsupported KV snapshot version: {header.get('version')}")
+        return z["k"], z["v"], header
+
+
+def restore_kv_slot(cache: KVCache, slot: int, k: np.ndarray, v: np.ndarray) -> KVCache:
+    """Write a snapshot back into slot's prefix; rest of the arena unchanged."""
+    position = k.shape[1]
+    dtype = cache.k.dtype
+    new_k = cache.k.at[:, slot, :position].set(jnp.asarray(k, dtype))
+    new_v = cache.v.at[:, slot, :position].set(jnp.asarray(v, dtype))
+    return KVCache(new_k, new_v)

@@ -1,0 +1,402 @@
+"""Continuous-batching JAX inference engine — the heart of the data plane.
+
+Replaces the reference's external LLM calls (examples/gpt-agent/app.py:98-109
+POSTs to OpenAI) with an in-process prefill+decode engine on the agent's
+TPU chips (BASELINE.json configs #2/#3). TPU-first design decisions:
+
+- **one compiled decode step, static shapes**: a fixed slot-batch
+  ``[max_batch]`` decodes every active sequence each step at its own cache
+  position (ragged positions via the model's scatter cache); idle slots
+  write to a reserved scratch slot — no recompiles as requests come and go;
+- **bucketed prefill**: prompts pad up to power-of-two buckets so prefill
+  compiles a handful of shapes, padding writes land on positions later
+  overwritten before any query can attend to them;
+- **TTFT = prefill**: the first token is sampled from the prefill logits,
+  so time-to-first-token is one prefill pass, not prefill + a decode step;
+- **sessions own KV**: a chat session keeps its cache slot between turns
+  (multi-turn TTFT stays flat); idle sessions evict LRU when slots run out;
+- **idempotent by request id**: completed results are memoized, so a
+  journal replay that races the original returns the stored result instead
+  of generating twice (the engine-side half of the crash-replay contract).
+
+The engine runs its JAX work on a dedicated worker thread; the aiohttp
+handlers (engine/llm_serve.py) talk to it through a thread-safe queue and
+asyncio futures.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..models.configs import ModelConfig, get_config
+from ..models.llama import KVCache, forward, init_params
+from .sampling import sample
+from .tokenizer import load_tokenizer
+
+PREFILL_BUCKETS = (32, 64, 128, 256, 512, 1024)
+
+
+@dataclass
+class GenRequest:
+    id: str
+    session: str
+    prompt_ids: list[int]
+    max_tokens: int
+    temperature: float
+    loop: asyncio.AbstractEventLoop
+    future: asyncio.Future
+    submitted_at: float = field(default_factory=time.monotonic)
+    ttft_ms: float | None = None
+    generated: list[int] = field(default_factory=list)
+
+
+@dataclass
+class Slot:
+    idx: int
+    session: str = ""
+    position: int = 0  # next cache position to write
+    request: GenRequest | None = None
+    last_used: float = 0.0
+    # the final sampled token of the previous reply was never fed through the
+    # model; it is prepended to the session's next prompt so the KV context
+    # stays exact across turns
+    pending_token: int | None = None
+
+
+class LLMEngine:
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params: dict,
+        tokenizer,
+        max_batch: int,
+        max_seq: int,
+    ):
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer
+        self.max_batch = max_batch
+        self.max_seq = max_seq
+        self.scratch_pos = max_seq - 1  # idle-slot write target; never generated into
+        self.cache = KVCache.create(cfg, max_batch, max_seq, dtype=params["embed"].dtype)
+        self.slots = [Slot(i) for i in range(max_batch)]
+        self.sessions: dict[str, int] = {}
+
+        self._queue: queue.Queue[GenRequest | None] = queue.Queue()
+        self._completed: collections.OrderedDict[str, dict] = collections.OrderedDict()
+        self._lock = threading.Lock()
+        self._rng = jax.random.PRNGKey(0)
+        self._running = True
+
+        # counters
+        self.tokens_generated = 0
+        self.prefills = 0
+        self.ttft_ms_recent: collections.deque[float] = collections.deque(maxlen=256)
+        self.decode_steps = 0
+        self._occupancy_sum = 0.0
+        self._started_at = time.monotonic()
+
+        self._build_compiled()
+        self._worker = threading.Thread(target=self._loop, daemon=True, name="llm-engine")
+        self._worker.start()
+
+    # -- construction -----------------------------------------------------
+    @classmethod
+    def create(
+        cls,
+        config_name: str,
+        checkpoint: str = "",
+        agent_id: str = "",
+        store=None,
+        options: dict | None = None,
+    ) -> "LLMEngine":
+        options = options or {}
+        cfg = get_config(config_name or "tiny")
+        tokenizer = load_tokenizer(cfg.vocab_size, checkpoint)
+        dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+        if checkpoint:
+            from .checkpoint import load_params
+
+            params = load_params(cfg, checkpoint, dtype=dtype)
+        else:
+            params = init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+        max_batch = int(options.get("max_batch", 8))
+        max_seq = int(options.get("max_seq", min(cfg.max_seq_len, 2048)))
+        engine = cls(cfg, params, tokenizer, max_batch=max_batch, max_seq=max_seq)
+        # pay the decode/prefill compiles here (inside the loader thread, while
+        # /health keeps answering) instead of on the first user request
+        engine.warmup()
+        return engine
+
+    def _build_compiled(self) -> None:
+        cfg = self.cfg
+
+        def prefill(params, cache, slot, tokens, positions, n_real):
+            # slice the slot's cache row, run the prompt, write the row back
+            rowk = lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1)
+            rowv = lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1)
+            logits, row = forward(params, cfg, tokens, positions, KVCache(rowk, rowv))
+            newk = lax.dynamic_update_slice_in_dim(cache.k, row.k, slot, axis=1)
+            newv = lax.dynamic_update_slice_in_dim(cache.v, row.v, slot, axis=1)
+            last = lax.dynamic_slice_in_dim(logits, n_real - 1, 1, axis=1)[0, 0]
+            return last, KVCache(newk, newv)
+
+        def decode(params, cache, tokens, positions, temps, key):
+            logits, cache = forward(params, cfg, tokens[:, None], positions[:, None], cache)
+            nxt = sample(logits[:, 0], key, temperature=temps)
+            return nxt, cache
+
+        self._prefill = jax.jit(prefill, donate_argnums=(1,))
+        self._decode = jax.jit(decode, donate_argnums=(1,))
+
+    def warmup(self) -> None:
+        """Compile the decode step and the smallest prefill bucket."""
+        toks = jnp.zeros((1, PREFILL_BUCKETS[0]), jnp.int32)
+        pos = jnp.zeros((1, PREFILL_BUCKETS[0]), jnp.int32)
+        _, self.cache = self._prefill(
+            self.params, self.cache, jnp.int32(0), toks, pos, jnp.int32(1)
+        )
+        nxt, self.cache = self._decode(
+            self.params,
+            self.cache,
+            jnp.zeros((self.max_batch,), jnp.int32),
+            jnp.full((self.max_batch,), self.scratch_pos, jnp.int32),
+            jnp.zeros((self.max_batch,), jnp.float32),
+            self._rng,
+        )
+        nxt.block_until_ready()
+
+    # -- public API (called from the aiohttp loop) ------------------------
+    async def generate(
+        self,
+        prompt: str,
+        max_tokens: int = 64,
+        temperature: float = 0.0,
+        request_id: str = "",
+        session: str = "",
+    ) -> dict:
+        if request_id:
+            with self._lock:
+                hit = self._completed.get(request_id)
+            if hit is not None:
+                return dict(hit, replayed=True)
+        loop = asyncio.get_running_loop()
+        prompt_ids = self.tokenizer.encode(prompt)
+        req = GenRequest(
+            id=request_id or f"gen-{time.monotonic_ns()}",
+            session=session,
+            prompt_ids=prompt_ids,
+            max_tokens=max(1, max_tokens),
+            temperature=temperature,
+            loop=loop,
+            future=loop.create_future(),
+        )
+        self._queue.put(req)
+        result = await req.future
+        if request_id:
+            with self._lock:
+                self._completed[request_id] = result
+                while len(self._completed) > 512:
+                    self._completed.popitem(last=False)
+        return result
+
+    async def chat(
+        self, session: str, message: str, max_tokens: int = 64, request_id: str = ""
+    ) -> dict:
+        return await self.generate(
+            prompt=message,
+            max_tokens=max_tokens,
+            temperature=0.0,
+            request_id=request_id,
+            session=session or "default",
+        )
+
+    def clear_sessions(self) -> None:
+        with self._lock:
+            self.sessions.clear()
+            for slot in self.slots:
+                if slot.request is None:
+                    slot.session = ""
+                    slot.position = 0
+
+    def metrics(self) -> dict:
+        elapsed = max(1e-6, time.monotonic() - self._started_at)
+        recent = sorted(self.ttft_ms_recent)
+        return {
+            "tokens_generated": self.tokens_generated,
+            "tokens_per_s": round(self.tokens_generated / elapsed, 2),
+            "prefills": self.prefills,
+            "decode_steps": self.decode_steps,
+            "batch_occupancy": round(self._occupancy_sum / max(1, self.decode_steps), 3),
+            "ttft_ms_p50": round(recent[len(recent) // 2], 2) if recent else None,
+            "active_sessions": len(self.sessions),
+            "max_batch": self.max_batch,
+            "max_seq": self.max_seq,
+        }
+
+    def shutdown(self) -> None:
+        self._running = False
+        self._queue.put(None)
+        self._worker.join(timeout=10)
+
+    # -- worker thread ----------------------------------------------------
+    def _loop(self) -> None:
+        waiting: list[GenRequest] = []
+        while self._running:
+            has_active = any(s.request is not None for s in self.slots)
+            try:
+                if has_active or waiting:
+                    item = self._queue.get_nowait()
+                else:
+                    item = self._queue.get(timeout=0.2)
+                if item is None:
+                    return
+                waiting.append(item)
+                # keep draining so a burst admits together
+                while True:
+                    item = self._queue.get_nowait()
+                    if item is None:
+                        return
+                    waiting.append(item)
+            except queue.Empty:
+                pass
+            waiting = [req for req in waiting if not self._try_admit(req)]
+            if any(s.request is not None for s in self.slots):
+                self._decode_step()
+            elif waiting:
+                time.sleep(0.002)  # all slots busy-by-session; brief backoff
+
+    def _try_admit(self, req: GenRequest) -> bool:
+        slot = self._find_slot(req.session)
+        if slot is None:
+            return False
+        prompt = list(req.prompt_ids)
+        if slot.pending_token is not None:
+            prompt = [slot.pending_token] + prompt
+            slot.pending_token = None
+        # continuation prompt must fit: otherwise reset the session's KV
+        budget = self.max_seq - 1 - req.max_tokens
+        if slot.position + len(prompt) > budget:
+            slot.position = 0
+        if len(prompt) > budget:
+            prompt = prompt[-budget:]  # keep the tail
+        self._run_prefill(slot, req, prompt)
+        return True
+
+    def _find_slot(self, session: str) -> Slot | None:
+        if session and session in self.sessions:
+            slot = self.slots[self.sessions[session]]
+            if slot.request is None:
+                return slot
+            return None  # session busy: one request per session at a time
+        # fresh slot: prefer never-used, else LRU idle session
+        idle = [s for s in self.slots if s.request is None]
+        if not idle:
+            return None
+        fresh = [s for s in idle if not s.session]
+        slot = fresh[0] if fresh else min(idle, key=lambda s: s.last_used)
+        if slot.session:
+            self.sessions.pop(slot.session, None)  # evict LRU session's KV
+        slot.session = session
+        slot.position = 0
+        slot.pending_token = None  # stale state from the previous occupant
+        if session:
+            self.sessions[session] = slot.idx
+        return slot
+
+    def _bucket(self, n: int) -> int:
+        for b in PREFILL_BUCKETS:
+            if n <= b:
+                return b
+        return PREFILL_BUCKETS[-1]
+
+    def _run_prefill(self, slot: Slot, req: GenRequest, prompt: list[int]) -> None:
+        n = len(prompt)
+        bucket = self._bucket(n)
+        padded = prompt + [0] * (bucket - n)
+        # padding positions continue past the real tokens; every such slot is
+        # rewritten by the real token that later occupies it before any query
+        # can attend to it (decode is sequential), so no garbage is visible
+        positions = np.arange(slot.position, slot.position + bucket, dtype=np.int32)
+        tokens = jnp.asarray(np.array(padded, dtype=np.int32)[None])
+        pos = jnp.asarray(positions[None])
+        last_logits, self.cache = self._prefill(
+            self.params, self.cache, jnp.int32(slot.idx), tokens, pos, jnp.int32(n)
+        )
+        self._rng, key = jax.random.split(self._rng)
+        first = sample(last_logits[None], key, temperature=jnp.asarray([req.temperature]))
+        first_id = int(first[0])
+        req.ttft_ms = 1000 * (time.monotonic() - req.submitted_at)
+        self.ttft_ms_recent.append(req.ttft_ms)
+        self.prefills += 1
+        slot.position += n
+        slot.request = req
+        slot.last_used = time.monotonic()
+        self._append_token(slot, first_id)
+
+    def _append_token(self, slot: Slot, token_id: int) -> None:
+        req = slot.request
+        req.generated.append(token_id)
+        self.tokens_generated += 1
+        done = len(req.generated) >= req.max_tokens or token_id == self.tokenizer.eos_id
+        if done:
+            self._finish(slot)
+
+    def _finish(self, slot: Slot) -> None:
+        req = slot.request
+        slot.request = None
+        slot.last_used = time.monotonic()
+        slot.pending_token = req.generated[-1] if req.generated else None
+        result = {
+            "text": self.tokenizer.decode(req.generated),
+            "tokens": req.generated,
+            "prompt_tokens": len(req.prompt_ids),
+            "completion_tokens": len(req.generated),
+            "ttft_ms": round(req.ttft_ms, 2) if req.ttft_ms else None,
+        }
+        req.loop.call_soon_threadsafe(_resolve, req.future, result)
+
+    def _decode_step(self) -> None:
+        tokens = np.zeros((self.max_batch,), np.int32)
+        positions = np.full((self.max_batch,), self.scratch_pos, np.int32)
+        temps = np.zeros((self.max_batch,), np.float32)
+        active: list[Slot] = []
+        for slot in self.slots:
+            if slot.request is not None:
+                tokens[slot.idx] = slot.request.generated[-1]
+                positions[slot.idx] = slot.position
+                temps[slot.idx] = slot.request.temperature
+                active.append(slot)
+        if not active:
+            return
+        self._rng, key = jax.random.split(self._rng)
+        nxt, self.cache = self._decode(
+            self.params,
+            self.cache,
+            jnp.asarray(tokens),
+            jnp.asarray(positions),
+            jnp.asarray(temps),
+            key,
+        )
+        nxt = np.asarray(nxt)
+        self.decode_steps += 1
+        self._occupancy_sum += len(active) / self.max_batch
+        for slot in active:
+            slot.position += 1  # the fed token now occupies a cache slot
+            self._append_token(slot, int(nxt[slot.idx]))
+
+
+def _resolve(future: asyncio.Future, result: dict) -> None:
+    if not future.done():
+        future.set_result(result)

@@ -25,6 +25,7 @@ from aiohttp import web
 from ..runtime.store_client import StoreClient
 
 MAX_TURNS = 50
+LOADING_HEADER = "X-Agentainer-Loading"
 
 
 class LLMServeApp:
@@ -41,6 +42,7 @@ class LLMServeApp:
         self.requests_total = 0
         self.engine = None
         self.engine_error = ""
+        self._ready = asyncio.Event()
 
     @property
     def convo_key(self) -> str:
@@ -58,7 +60,7 @@ class LLMServeApp:
                 agent_id=self.agent_id,
                 store=self.store,
             )
-        except Exception as e:  # engine stays None; /chat reports 503
+        except BaseException as e:  # engine stays None; /chat reports 503
             self.engine_error = f"{type(e).__name__}: {e}"
 
     def app(self) -> web.Application:
@@ -72,7 +74,13 @@ class LLMServeApp:
         app.router.add_get("/metrics", self.h_metrics)
 
         async def boot(app):
-            app["loader"] = asyncio.create_task(asyncio.to_thread(self._load_engine))
+            async def load():
+                try:
+                    await asyncio.to_thread(self._load_engine)
+                finally:
+                    self._ready.set()  # set even on loader death: waiters unblock
+
+            app["loader"] = asyncio.create_task(load())
 
         async def cleanup(app):
             if self.engine is not None:
@@ -106,13 +114,26 @@ class LLMServeApp:
         )
 
     async def _ensure_engine(self) -> web.Response | None:
+        # While the model loads, answer fast with a "loading" marker instead
+        # of stalling handlers: the proxy treats it like engine-not-ready
+        # (journal entry stays pending, no retry charged, nothing executes
+        # twice) and the replay worker re-dispatches once loading finishes.
+        # The short bounded wait spares the round-trip when load is nearly
+        # done; the Event is set by the loader even if it dies.
+        if self.engine is None and not self.engine_error:
+            try:
+                await asyncio.wait_for(self._ready.wait(), timeout=2.0)
+            except asyncio.TimeoutError:
+                pass
         if self.engine is not None:
             return None
         if self.engine_error:
             return web.json_response(
                 {"error": f"model runtime failed to load: {self.engine_error}"}, status=503
             )
-        return web.json_response({"error": "model still loading"}, status=503)
+        return web.json_response(
+            {"error": "model loading"}, status=503, headers={LOADING_HEADER: "true"}
+        )
 
     async def h_chat(self, request: web.Request) -> web.Response:
         self.requests_total += 1

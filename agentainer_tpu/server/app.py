@@ -565,6 +565,13 @@ class ControlPlaneApp:
             if request_id:
                 self.s.journal.mark_failed(agent_id, request_id, f"{type(e).__name__}: {e}")
             return DISPATCH_FAILED, {}, b""
+        if resp.status == 503 and resp_headers.get("X-Agentainer-Loading", "").lower() == "true":
+            # engine process is up but its model is still loading: same
+            # journal treatment as engine-gone — stays pending, no retry
+            # charged, the replay worker re-dispatches after load
+            if request_id:
+                self.s.journal.mark_pending(agent_id, request_id)
+            return DISPATCH_ENGINE_GONE, {}, b""
         if request_id:
             self.s.journal.store_response(
                 agent_id, request_id, resp.status, resp_headers, resp_body

@@ -1,0 +1,105 @@
+"""Full-stack LLM agent test: deploy llm:tiny → engine subprocess loads the
+JAX model → chat through the proxy → TTFT/usage reported → history durable.
+
+This is BASELINE.json config #2 in miniature (CPU instead of a chip — the
+engine code path is identical; the platform comes from the environment).
+"""
+
+import asyncio
+import json
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from agentainer_tpu.config import Config
+from agentainer_tpu.daemon import build_services
+from agentainer_tpu.runtime.local import LocalBackend
+from agentainer_tpu.store import MemoryStore
+
+TOKEN = "llm-e2e-token"
+AUTH = {"Authorization": f"Bearer {TOKEN}"}
+
+
+def test_llm_agent_end_to_end(tmp_path):
+    async def body():
+        cfg = Config()
+        cfg.auth_token = TOKEN
+        backend = LocalBackend(data_dir=str(tmp_path), ready_timeout_s=120.0)
+        services = build_services(
+            config=cfg,
+            store=MemoryStore(),
+            backend=backend,
+            console_logs=False,
+            data_dir=str(tmp_path),
+        )
+        client = TestClient(TestServer(services.app))
+        await client.start_server()
+        backend.set_control(f"http://127.0.0.1:{client.server.port}")
+        try:
+            resp = await client.post(
+                "/agents",
+                json={
+                    "name": "llm-tiny",
+                    "model": {
+                        "engine": "llm",
+                        "config": "tiny",
+                        "options": {"max_batch": 2, "max_seq": 128},
+                    },
+                    # the engine subprocess must stay off the TPU in CI
+                    "env": {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
+                },
+                headers=AUTH,
+            )
+            assert resp.status == 200, await resp.text()
+            agent = (await resp.json())["data"]
+            resp = await client.post(f"/agents/{agent['id']}/start", headers=AUTH)
+            assert resp.status == 200, await resp.text()
+
+            # model loads in a background thread; poll readiness
+            for _ in range(300):
+                resp = await client.get(f"/agent/{agent['id']}/metrics")
+                doc = await resp.json()
+                if doc.get("model_loaded"):
+                    break
+                await asyncio.sleep(0.2)
+            assert doc.get("model_loaded"), doc
+
+            resp = await client.post(
+                f"/agent/{agent['id']}/chat",
+                data=json.dumps({"message": "hello tpu world", "max_tokens": 8}),
+            )
+            assert resp.status == 200, await resp.text()
+            doc = await resp.json()
+            assert doc["model"] == "tiny"
+            assert doc["usage"]["completion_tokens"] == 8
+            assert doc["ttft_ms"] is not None
+            assert isinstance(doc["response"], str)
+
+            # second turn, same session: history durable in the control plane
+            resp = await client.post(
+                f"/agent/{agent['id']}/chat",
+                data=json.dumps({"message": "second", "max_tokens": 4}),
+            )
+            assert resp.status == 200
+            resp = await client.get(f"/agent/{agent['id']}/history")
+            hist = (await resp.json())["history"]
+            contents = [t["content"] for t in hist]
+            assert "hello tpu world" in contents and "second" in contents
+
+            # raw completion endpoint
+            resp = await client.post(
+                f"/agent/{agent['id']}/generate",
+                data=json.dumps({"prompt": "abc", "max_tokens": 4}),
+            )
+            assert resp.status == 200
+            gen = await resp.json()
+            assert gen["completion_tokens"] == 4
+
+            # engine serving counters surface through the metrics plane
+            stats = services.backend.stats(services.manager.get_agent(agent["id"]).engine_id)
+            assert stats["tokens_generated"] >= 16
+            assert stats["ttft_ms_p50"] is not None
+        finally:
+            backend.close()
+            await client.close()
+
+    asyncio.run(body())

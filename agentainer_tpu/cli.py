@@ -1,0 +1,338 @@
+"""``agentainer`` CLI — verb parity with the reference's cobra tree.
+
+Reference commands (cmd/agentainer/main.go:266-282): server, deploy, start,
+stop, restart, pause, resume, remove, logs, list, invoke, requests, health,
+metrics, backup {create,list,restore,delete}, audit. All lifecycle verbs are
+thin HTTP clients against the management API with a bearer token
+(makeAPIRequest parity, main.go:577-613); ``server`` runs the daemon.
+
+Usage:  python -m agentainer_tpu.cli <command> [...]   (or the `agentainer`
+console script once installed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import requests as http
+
+from .config import load_config
+
+
+def _base(args) -> str:
+    return args.server.rstrip("/")
+
+
+def _headers(args) -> dict:
+    return {"Authorization": f"Bearer {args.token}"}
+
+
+def _call(args, method: str, path: str, body: dict | None = None) -> dict:
+    url = _base(args) + path
+    resp = http.request(method, url, json=body, headers=_headers(args), timeout=60)
+    try:
+        doc = resp.json()
+    except ValueError:
+        print(f"error: non-JSON response ({resp.status_code})", file=sys.stderr)
+        sys.exit(1)
+    if not doc.get("success", False):
+        print(f"error: {doc.get('message', resp.status_code)}", file=sys.stderr)
+        sys.exit(1)
+    return doc
+
+
+def _print(data) -> None:
+    print(json.dumps(data, indent=2, default=str))
+
+
+def _parse_env(pairs: list[str]) -> dict[str, str]:
+    env = {}
+    for pair in pairs or []:
+        key, sep, val = pair.partition("=")
+        if not sep:
+            raise SystemExit(f"--env expects KEY=VALUE, got {pair!r}")
+        env[key] = val
+    return env
+
+
+# -- commands -------------------------------------------------------------
+def cmd_server(args) -> None:
+    import asyncio
+
+    from .daemon import build_services, run_daemon
+
+    cfg = load_config(args.config)
+    if args.port:
+        cfg.server.port = args.port
+    services = build_services(config=cfg)
+    try:
+        asyncio.run(run_daemon(services))
+    except KeyboardInterrupt:
+        pass
+
+
+def cmd_deploy(args) -> None:
+    if args.file:
+        from .manager.deployconfig import fan_out, load_deployment
+
+        config = load_deployment(args.file)
+        for spec in config.agents:
+            for name, s in fan_out(spec):
+                doc = _call(
+                    args,
+                    "POST",
+                    "/agents",
+                    {
+                        "name": name,
+                        "model": s.model.to_dict(),
+                        "env": s.env,
+                        "resources": s.resources.to_dict(),
+                        "auto_restart": s.auto_restart,
+                        "health_check": s.health_check.to_dict() if s.health_check else None,
+                    },
+                )
+                agent = doc["data"]
+                print(f"deployed {name}: {agent['id']}")
+                if args.start:
+                    _call(args, "POST", f"/agents/{agent['id']}/start")
+                    print(f"started {agent['id']}")
+        return
+    body = {
+        "name": args.name,
+        "model": args.model,
+        "env": _parse_env(args.env),
+        "resources": {"chips": args.chips, "hbm_bytes": args.hbm_bytes},
+        "auto_restart": args.auto_restart,
+    }
+    if args.health_endpoint:
+        body["health_check"] = {
+            "endpoint": args.health_endpoint,
+            "interval_s": args.health_interval,
+            "timeout_s": args.health_timeout,
+            "retries": args.health_retries,
+        }
+    doc = _call(args, "POST", "/agents", body)
+    agent = doc["data"]
+    print(f"deployed {agent['name']}: {agent['id']}")
+    if args.start:
+        _call(args, "POST", f"/agents/{agent['id']}/start")
+        print(f"started {agent['id']}")
+
+
+def _lifecycle(op: str):
+    def cmd(args) -> None:
+        doc = _call(args, "POST", f"/agents/{args.agent_id}/{op}")
+        agent = doc["data"]
+        print(f"{op}: {agent['id']} is {agent['status']}")
+
+    return cmd
+
+
+def cmd_remove(args) -> None:
+    _call(args, "DELETE", f"/agents/{args.agent_id}")
+    print(f"removed {args.agent_id}")
+
+
+def cmd_list(args) -> None:
+    doc = _call(args, "GET", "/agents")
+    rows = doc["data"]
+    if args.json:
+        _print(rows)
+        return
+    fmt = "{:<28} {:<16} {:<9} {:<12} {}"
+    print(fmt.format("ID", "NAME", "STATUS", "MODEL", "CHIPS"))
+    for a in rows:
+        chips = (a.get("placement") or {}).get("chips", [])
+        model = a["model"]["engine"] + (f":{a['model']['config']}" if a["model"]["config"] else "")
+        print(fmt.format(a["id"], a["name"][:16], a["status"], model[:12], chips))
+
+
+def cmd_logs(args) -> None:
+    doc = _call(args, "GET", f"/agents/{args.agent_id}/logs?tail={args.tail}")
+    for line in doc["data"]["logs"]:
+        print(line)
+
+
+def cmd_invoke(args) -> None:
+    """POST through the proxy (reference `invoke`, main.go parity)."""
+    url = f"{_base(args)}/agent/{args.agent_id}{args.path}"
+    body = args.data.encode() if args.data else None
+    resp = http.request(args.method, url, data=body, timeout=120)
+    print(f"HTTP {resp.status_code}")
+    print(resp.text)
+
+
+def cmd_requests(args) -> None:
+    doc = _call(args, "GET", f"/agents/{args.agent_id}/requests?status={args.status}")
+    data = doc["data"]
+    print(f"stats: {data['stats']}")
+    for r in data["requests"]:
+        print(f"  {r['id']}  {r['method']} {r['path']}  {r['status']}  retries={r['retry_count']}")
+
+
+def cmd_health(args) -> None:
+    if args.agent_id:
+        _print(_call(args, "GET", f"/agents/{args.agent_id}/health")["data"])
+    else:
+        _print(_call(args, "GET", "/health")["data"])
+
+
+def cmd_metrics(args) -> None:
+    if args.agent_id:
+        path = f"/agents/{args.agent_id}/metrics"
+        if args.history:
+            path += "/history"
+        _print(_call(args, "GET", path)["data"])
+    else:
+        _print(_call(args, "GET", "/metrics")["data"])
+
+
+def cmd_slice(args) -> None:
+    _print(_call(args, "GET", "/slice")["data"])
+
+
+def cmd_backup(args) -> None:
+    if args.backup_cmd == "create":
+        doc = _call(args, "POST", "/backups", {"name": args.name, "description": args.description})
+        print(f"created {doc['data']['id']} ({doc['data']['agents']} agents)")
+    elif args.backup_cmd == "list":
+        _print(_call(args, "GET", "/backups")["data"])
+    elif args.backup_cmd == "restore":
+        doc = _call(args, "POST", f"/backups/{args.backup_id}/restore")
+        print(f"restored {len(doc['data'])} agents")
+    elif args.backup_cmd == "delete":
+        _call(args, "DELETE", f"/backups/{args.backup_id}")
+        print(f"deleted {args.backup_id}")
+
+
+def cmd_audit(args) -> None:
+    path = f"/audit?limit={args.limit}"
+    if args.action:
+        path += f"&action={args.action}"
+    for e in _call(args, "GET", path)["data"]:
+        print(f"{e['ts']:.0f}  {e['user']:<12} {e['action']:<16} {e['resource']:<32} {e['result']}")
+
+
+def cmd_atlogs(args) -> None:
+    path = f"/logs?limit={args.limit}"
+    if args.component:
+        path += f"&component={args.component}"
+    for e in _call(args, "GET", path)["data"]:
+        print(f"{e['ts']:.0f}  {e['level']:<5} {e['component']:<12} {e['message']}")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    cfg = load_config()
+    p = argparse.ArgumentParser(prog="agentainer", description=__doc__)
+    p.add_argument(
+        "--server",
+        default=os.environ.get("ATPU_SERVER_URL", f"http://127.0.0.1:{cfg.server.port}"),
+        help="management API base URL",
+    )
+    p.add_argument("--token", default=cfg.auth_token, help="bearer token")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("server", help="run the control-plane daemon")
+    s.add_argument("--config", default=None)
+    s.add_argument("--port", type=int, default=None)
+    s.set_defaults(fn=cmd_server)
+
+    s = sub.add_parser("deploy", help="deploy an agent (or -f deployment.yaml)")
+    s.add_argument("--name")
+    s.add_argument("--model", default="echo", help='engine[:config], e.g. "llm:llama3-8b"')
+    s.add_argument("--env", action="append", default=[], metavar="KEY=VALUE")
+    s.add_argument("--chips", type=int, default=1)
+    s.add_argument("--hbm-bytes", type=int, default=8 * 1024**3)
+    s.add_argument("--auto-restart", action="store_true")
+    s.add_argument("--health-endpoint", default="")
+    s.add_argument("--health-interval", type=float, default=30.0)
+    s.add_argument("--health-timeout", type=float, default=5.0)
+    s.add_argument("--health-retries", type=int, default=3)
+    s.add_argument("--start", action="store_true", help="start right after deploy")
+    s.add_argument("-f", "--file", help="AgentDeployment YAML")
+    s.set_defaults(fn=cmd_deploy)
+
+    for op in ("start", "stop", "restart", "pause", "resume"):
+        s = sub.add_parser(op, help=f"{op} an agent")
+        s.add_argument("agent_id")
+        s.set_defaults(fn=_lifecycle(op))
+
+    s = sub.add_parser("remove", help="remove an agent and all its state")
+    s.add_argument("agent_id")
+    s.set_defaults(fn=cmd_remove)
+
+    s = sub.add_parser("list", help="list agents")
+    s.add_argument("--json", action="store_true")
+    s.set_defaults(fn=cmd_list)
+
+    s = sub.add_parser("logs", help="engine logs")
+    s.add_argument("agent_id")
+    s.add_argument("--tail", type=int, default=100)
+    s.set_defaults(fn=cmd_logs)
+
+    s = sub.add_parser("invoke", help="send a request through the proxy")
+    s.add_argument("agent_id")
+    s.add_argument("path", help="e.g. /chat")
+    s.add_argument("--method", default="POST")
+    s.add_argument("--data", default="")
+    s.set_defaults(fn=cmd_invoke)
+
+    s = sub.add_parser("requests", help="journaled requests for an agent")
+    s.add_argument("agent_id")
+    s.add_argument("--status", default="pending")
+    s.set_defaults(fn=cmd_requests)
+
+    s = sub.add_parser("health", help="server or agent health")
+    s.add_argument("agent_id", nargs="?", default="")
+    s.set_defaults(fn=cmd_health)
+
+    s = sub.add_parser("metrics", help="metrics (all agents or one)")
+    s.add_argument("agent_id", nargs="?", default="")
+    s.add_argument("--history", action="store_true")
+    s.set_defaults(fn=cmd_metrics)
+
+    s = sub.add_parser("slice", help="chip topology + placements")
+    s.set_defaults(fn=cmd_slice)
+
+    s = sub.add_parser("backup", help="backup management")
+    bs = s.add_subparsers(dest="backup_cmd", required=True)
+    b = bs.add_parser("create")
+    b.add_argument("--name", default="")
+    b.add_argument("--description", default="")
+    for name in ("restore", "delete"):
+        b = bs.add_parser(name)
+        b.add_argument("backup_id")
+    bs.add_parser("list")
+    s.set_defaults(fn=cmd_backup)
+
+    s = sub.add_parser("audit", help="audit trail")
+    s.add_argument("--limit", type=int, default=50)
+    s.add_argument("--action", default="")
+    s.set_defaults(fn=cmd_audit)
+
+    s = sub.add_parser("logs-server", help="control-plane structured logs")
+    s.add_argument("--limit", type=int, default=50)
+    s.add_argument("--component", default="")
+    s.set_defaults(fn=cmd_atlogs)
+
+    return p
+
+
+def main(argv: list[str] | None = None) -> None:
+    args = build_parser().parse_args(argv)
+    try:
+        args.fn(args)
+    except BrokenPipeError:
+        # stdout piped into head/less that exited: not an error
+        try:
+            sys.stdout.close()
+        except Exception:
+            pass
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -62,6 +62,20 @@ class GenRequest:
 
 
 @dataclass
+class RestoreCmd:
+    """Worker-queue command: write a KV snapshot into a slot (restores a
+    session after an engine restart — BASELINE.json config #3)."""
+
+    session: str
+    k: Any  # np [L, pos, KV, hd]
+    v: Any
+    position: int
+    pending_token: int | None
+    loop: asyncio.AbstractEventLoop
+    future: asyncio.Future
+
+
+@dataclass
 class Slot:
     idx: int
     session: str = ""
@@ -72,6 +86,9 @@ class Slot:
     # model; it is prepended to the session's next prompt so the KV context
     # stays exact across turns
     pending_token: int | None = None
+    # bumped whenever the slot is reassigned or its position resets; lets a
+    # concurrent snapshot detect that its prefix went stale mid-serialize
+    epoch: int = 0
 
 
 class LLMEngine:
@@ -82,12 +99,14 @@ class LLMEngine:
         tokenizer,
         max_batch: int,
         max_seq: int,
+        decode_chunk: int = 8,
     ):
         self.cfg = cfg
         self.params = params
         self.tokenizer = tokenizer
         self.max_batch = max_batch
         self.max_seq = max_seq
+        self.decode_chunk = max(1, decode_chunk)
         self.scratch_pos = max_seq - 1  # idle-slot write target; never generated into
         self.cache = KVCache.create(cfg, max_batch, max_seq, dtype=params["embed"].dtype)
         self.slots = [Slot(i) for i in range(max_batch)]
@@ -133,7 +152,10 @@ class LLMEngine:
             params = init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
         max_batch = int(options.get("max_batch", 8))
         max_seq = int(options.get("max_seq", min(cfg.max_seq_len, 2048)))
-        engine = cls(cfg, params, tokenizer, max_batch=max_batch, max_seq=max_seq)
+        decode_chunk = int(options.get("decode_chunk", 8))
+        engine = cls(
+            cfg, params, tokenizer, max_batch=max_batch, max_seq=max_seq, decode_chunk=decode_chunk
+        )
         # pay the decode/prefill compiles here (inside the loader thread, while
         # /health keeps answering) instead of on the first user request
         engine.warmup()
@@ -152,28 +174,40 @@ class LLMEngine:
             last = lax.dynamic_slice_in_dim(logits, n_real - 1, 1, axis=1)[0, 0]
             return last, KVCache(newk, newv)
 
-        def decode(params, cache, tokens, positions, temps, key):
-            logits, cache = forward(params, cfg, tokens[:, None], positions[:, None], cache)
-            nxt = sample(logits[:, 0], key, temperature=temps)
-            return nxt, cache
+        def decode_n(params, cache, tokens, positions, temps, keys):
+            """Kernel-looped decode: ``chunk`` autoregressive steps inside one
+            compiled call (lax.scan), so the host↔device round trip is paid
+            once per chunk, not once per token. Tokens a request doesn't end
+            up using are rolled back by the worker (their cache writes are
+            overwritten before any later query can attend to them)."""
+
+            def step(carry, key):
+                tok, pos, cache = carry
+                logits, cache = forward(params, cfg, tok[:, None], pos[:, None], cache)
+                nxt = sample(logits[:, 0], key, temperature=temps)
+                return (nxt, pos + 1, cache), nxt
+
+            (_, _, cache), toks = lax.scan(step, (tokens, positions, cache), keys)
+            return toks, cache  # toks [chunk, B]
 
         self._prefill = jax.jit(prefill, donate_argnums=(1,))
-        self._decode = jax.jit(decode, donate_argnums=(1,))
+        self._decode_n = jax.jit(decode_n, donate_argnums=(1,))
 
     def warmup(self) -> None:
-        """Compile the decode step and the smallest prefill bucket."""
+        """Compile the decode chunk and the smallest prefill bucket."""
         toks = jnp.zeros((1, PREFILL_BUCKETS[0]), jnp.int32)
         pos = jnp.zeros((1, PREFILL_BUCKETS[0]), jnp.int32)
         _, self.cache = self._prefill(
             self.params, self.cache, jnp.int32(0), toks, pos, jnp.int32(1)
         )
-        nxt, self.cache = self._decode(
+        keys = jax.random.split(self._rng, self.decode_chunk)
+        nxt, self.cache = self._decode_n(
             self.params,
             self.cache,
             jnp.zeros((self.max_batch,), jnp.int32),
             jnp.full((self.max_batch,), self.scratch_pos, jnp.int32),
             jnp.zeros((self.max_batch,), jnp.float32),
-            self._rng,
+            keys,
         )
         nxt.block_until_ready()
 
@@ -221,6 +255,54 @@ class LLMEngine:
             request_id=request_id,
             session=session or "default",
         )
+
+    def snapshot_session(self, session: str) -> bytes | None:
+        """Serialize a session's live KV prefix for the store.
+
+        Safe to call from any thread: the position is read before the cache
+        reference, and jax arrays are immutable, so the captured cache is
+        same-or-newer than the captured position — a consistent prefix.
+        """
+        idx = self.sessions.get(session)
+        if idx is None:
+            return None
+        slot = self.slots[idx]
+        if slot.request is not None:
+            return None  # mid-generation; snapshot after it settles
+        epoch = slot.epoch
+        position = slot.position
+        if position <= 0:
+            return None
+        from .checkpoint import serialize_kv_slot
+
+        cache = self.cache
+        blob = serialize_kv_slot(
+            cache, idx, position, meta={"session": session, "pending_token": slot.pending_token}
+        )
+        # the worker may have evicted/reset this slot while we serialized —
+        # position is only monotonic within an epoch, so a bumped epoch means
+        # the captured prefix may mix another session's KV: discard it
+        if slot.epoch != epoch or slot.session != session:
+            return None
+        return blob
+
+    async def restore_session(self, session: str, blob: bytes) -> bool:
+        """Load a snapshot into a fresh slot (worker-thread mediated)."""
+        from .checkpoint import deserialize_kv_slot
+
+        k, v, header = deserialize_kv_slot(blob)
+        loop = asyncio.get_running_loop()
+        cmd = RestoreCmd(
+            session=session,
+            k=k,
+            v=v,
+            position=int(header["position"]),
+            pending_token=header.get("pending_token"),
+            loop=loop,
+            future=loop.create_future(),
+        )
+        self._queue.put(cmd)
+        return await cmd.future
 
     def clear_sessions(self) -> None:
         with self._lock:
@@ -271,11 +353,50 @@ class LLMEngine:
                     waiting.append(item)
             except queue.Empty:
                 pass
-            waiting = [req for req in waiting if not self._try_admit(req)]
-            if any(s.request is not None for s in self.slots):
-                self._decode_step()
-            elif waiting:
+            still = []
+            for item in waiting:
+                try:
+                    if isinstance(item, RestoreCmd):
+                        self._do_restore(item)
+                    elif not self._try_admit(item):
+                        still.append(item)
+                except Exception as e:
+                    # a poisoned request/snapshot must not kill the worker
+                    self._fail_item(item, e)
+            waiting = still
+            try:
+                if any(s.request is not None for s in self.slots):
+                    self._decode_step()
+            except Exception as e:
+                # fail every in-flight request rather than hanging them
+                for slot in self.slots:
+                    if slot.request is not None:
+                        self._fail_item(slot.request, e)
+                        slot.request = None
+            if not any(s.request is not None for s in self.slots) and waiting:
                 time.sleep(0.002)  # all slots busy-by-session; brief backoff
+
+    def _do_restore(self, cmd: RestoreCmd) -> None:
+        from .checkpoint import restore_kv_slot
+
+        ok = False
+        try:
+            slot = self._find_slot(cmd.session)
+            if slot is not None and cmd.position < self.max_seq - 1:
+                self.cache = restore_kv_slot(self.cache, slot.idx, cmd.k, cmd.v)
+                slot.position = cmd.position
+                slot.pending_token = cmd.pending_token
+                ok = True
+        finally:
+            # resolve even on exception (shape-mismatched snapshots from a
+            # redeployed model config must not hang the caller)
+            cmd.loop.call_soon_threadsafe(_resolve_value, cmd.future, ok)
+
+    def _fail_item(self, item, error: Exception) -> None:
+        fut = getattr(item, "future", None)
+        loop = getattr(item, "loop", None)
+        if fut is not None and loop is not None:
+            loop.call_soon_threadsafe(_reject, fut, error)
 
     def _try_admit(self, req: GenRequest) -> bool:
         slot = self._find_slot(req.session)
@@ -289,6 +410,7 @@ class LLMEngine:
         budget = self.max_seq - 1 - req.max_tokens
         if slot.position + len(prompt) > budget:
             slot.position = 0
+            slot.epoch += 1
         if len(prompt) > budget:
             prompt = prompt[-budget:]  # keep the tail
         self._run_prefill(slot, req, prompt)
@@ -311,6 +433,7 @@ class LLMEngine:
         slot.session = session
         slot.position = 0
         slot.pending_token = None  # stale state from the previous occupant
+        slot.epoch += 1
         if session:
             self.sessions[session] = slot.idx
         return slot
@@ -351,13 +474,17 @@ class LLMEngine:
         self.tokens_generated += 1
         done = len(req.generated) >= req.max_tokens or token_id == self.tokenizer.eos_id
         if done:
-            self._finish(slot)
+            self._finish(slot, pending_last=True)
 
-    def _finish(self, slot: Slot) -> None:
+    def _finish(self, slot: Slot, pending_last: bool) -> None:
+        """``pending_last``: the final generated token was sampled but not yet
+        fed through the model (it is absent from the slot's KV); carry it
+        into the session's next prompt. When a chunked decode already fed it
+        (mid-chunk finish), the caller passes False."""
         req = slot.request
         slot.request = None
         slot.last_used = time.monotonic()
-        slot.pending_token = req.generated[-1] if req.generated else None
+        slot.pending_token = (req.generated[-1] if req.generated else None) if pending_last else None
         result = {
             "text": self.tokenizer.decode(req.generated),
             "tokens": req.generated,
@@ -368,6 +495,7 @@ class LLMEngine:
         req.loop.call_soon_threadsafe(_resolve, req.future, result)
 
     def _decode_step(self) -> None:
+        chunk = self.decode_chunk
         tokens = np.zeros((self.max_batch,), np.int32)
         positions = np.full((self.max_batch,), self.scratch_pos, np.int32)
         temps = np.zeros((self.max_batch,), np.float32)
@@ -381,22 +509,56 @@ class LLMEngine:
         if not active:
             return
         self._rng, key = jax.random.split(self._rng)
-        nxt, self.cache = self._decode(
+        keys = jax.random.split(key, chunk)
+        toks, self.cache = self._decode_n(
             self.params,
             self.cache,
             jnp.asarray(tokens),
             jnp.asarray(positions),
             jnp.asarray(temps),
-            key,
+            keys,
         )
-        nxt = np.asarray(nxt)
+        toks = np.asarray(toks)  # [chunk, B]
         self.decode_steps += 1
         self._occupancy_sum += len(active) / self.max_batch
+        eos = self.tokenizer.eos_id
         for slot in active:
-            slot.position += 1  # the fed token now occupies a cache slot
-            self._append_token(slot, int(nxt[slot.idx]))
+            req = slot.request
+            start = slot.position
+            remaining = req.max_tokens - len(req.generated)
+            outs = toks[:, slot.idx]
+            used = 0
+            hit_eos = False
+            for j in range(min(chunk, remaining)):
+                used += 1
+                if int(outs[j]) == eos:
+                    hit_eos = True
+                    break
+            req.generated.extend(int(t) for t in outs[:used])
+            self.tokens_generated += used
+            finished = hit_eos or len(req.generated) >= req.max_tokens
+            if finished and used < chunk:
+                # chunk overshot: the used-th token was already fed at
+                # position start+used; later writes overwrite the overshoot
+                slot.position = start + used + 1
+                self._finish(slot, pending_last=False)
+            elif finished:
+                slot.position = start + chunk
+                self._finish(slot, pending_last=True)
+            else:
+                slot.position = start + chunk
 
 
 def _resolve(future: asyncio.Future, result: dict) -> None:
     if not future.done():
         future.set_result(result)
+
+
+def _resolve_value(future: asyncio.Future, value) -> None:
+    if not future.done():
+        future.set_result(value)
+
+
+def _reject(future: asyncio.Future, error: Exception) -> None:
+    if not future.done():
+        future.set_exception(RuntimeError(f"engine worker error: {error}"))

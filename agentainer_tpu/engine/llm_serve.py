@@ -43,10 +43,28 @@ class LLMServeApp:
         self.engine = None
         self.engine_error = ""
         self._ready = asyncio.Event()
+        self.kv_restores = 0
+        self.kv_snapshots = 0
+        self._bg_tasks: set[asyncio.Task] = set()  # keep snapshot tasks alive
 
     @property
     def convo_key(self) -> str:
         return f"agent:{self.agent_id}:conversations"
+
+    def _kv_key(self, session: str) -> str:
+        return f"agent:{self.agent_id}:kvcache:{session}"
+
+    async def _snapshot_session(self, session: str) -> None:
+        """Fire-and-forget KV snapshot after a turn settles (async host
+        offload keeps TTFT out of the snapshot's way — SURVEY.md §7 hard
+        part #2)."""
+        try:
+            blob = await asyncio.to_thread(self.engine.snapshot_session, session)
+            if blob:
+                await self.store.set_bytes(self._kv_key(session), blob, ttl=24 * 3600)
+                self.kv_snapshots += 1
+        except Exception:
+            pass
 
     def _load_engine(self) -> None:
         """Build the JAX engine (slow: compile + weight init). Runs in a
@@ -149,9 +167,26 @@ class LLMServeApp:
         max_tokens = int(body.get("max_tokens", 64))
         request_id = request.headers.get("X-Agentainer-Request-ID", "")
 
+        # crash-resume: an unknown session may have a KV snapshot in the
+        # store from a previous engine life — restore it before generating
+        # so the conversation continues from its exact context
+        if self.store.connected and session not in self.engine.sessions:
+            try:
+                blob = await self.store.get_bytes(self._kv_key(session))
+                if blob:
+                    restored = await self.engine.restore_session(session, blob)
+                    if restored:
+                        self.kv_restores += 1
+            except Exception:
+                pass
+
         result = await self.engine.chat(
             session=session, message=message, max_tokens=max_tokens, request_id=request_id
         )
+        if self.store.connected:
+            task = asyncio.ensure_future(self._snapshot_session(session))
+            self._bg_tasks.add(task)  # an unreferenced task can be GC'd mid-flight
+            task.add_done_callback(self._bg_tasks.discard)
         now = time.time()
         try:
             await self.store.rpush(
@@ -213,6 +248,10 @@ class LLMServeApp:
         self.requests_total += 1
         try:
             await self.store.delete(self.convo_key)
+            # KV snapshots must go too, or crash-resume would resurrect the
+            # conversation the user just asked to forget
+            for key in await self.store.keys(f"agent:{self.agent_id}:kvcache:*"):
+                await self.store.delete(key)
         except Exception:
             pass
         if self.engine is not None:
@@ -226,6 +265,8 @@ class LLMServeApp:
             "requests_total": self.requests_total,
             "uptime_s": time.time() - self.started_at,
             "model_loaded": self.engine is not None,
+            "kv_snapshots": self.kv_snapshots,
+            "kv_restores": self.kv_restores,
         }
         if self.engine is not None:
             doc.update(self.engine.metrics())

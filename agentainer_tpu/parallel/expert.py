@@ -1,0 +1,64 @@
+"""Expert parallelism for MoE layers (BASELINE.json config #5).
+
+Experts shard over the ``ep`` mesh axis: each device owns ``E/ep`` experts'
+weights (the HBM win — Mixtral-8x7B's experts dominate its footprint) and
+computes their contribution for every token; a ``psum`` over ``ep`` combines
+the top-k-weighted partial outputs. Routing happens replicated (router
+weights are small), so no token permutation/all-to-all is needed on the
+dense-combine path; an all-to-all token-dispatch variant can replace the
+psum when capacity factors make dense compute wasteful.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from ..models.configs import ModelConfig
+
+
+def _moe_local(x, router, w_gate, w_up, w_down, *, axis_name: str, cfg: ModelConfig):
+    """x [B,T,D] replicated over ep; expert weights sharded on their leading
+    expert axis: w_gate/w_up [E/ep, D, F], w_down [E/ep, F, D]."""
+    ax = lax.axis_index(axis_name)
+    e_local = w_gate.shape[0]
+    # replicated routing over the FULL expert set
+    logits = x @ router  # [B,T,E]
+    weights, chosen = lax.top_k(logits, cfg.experts_per_token)
+    weights = jax.nn.softmax(weights.astype(jnp.float32), axis=-1).astype(x.dtype)
+    onehot = jax.nn.one_hot(chosen, cfg.n_experts, dtype=x.dtype)  # [B,T,K,E]
+    combine = jnp.einsum("btk,btke->bte", weights, onehot)  # [B,T,E]
+    # slice my experts' combine weights
+    my_combine = lax.dynamic_slice_in_dim(combine, ax * e_local, e_local, axis=2)
+    gate = jax.nn.silu(jnp.einsum("btd,edf->btef", x, w_gate))
+    up = jnp.einsum("btd,edf->btef", x, w_up)
+    expert_out = jnp.einsum("btef,efd->bted", gate * up, w_down)
+    partial_out = jnp.einsum("bted,bte->btd", expert_out, my_combine)
+    return lax.psum(partial_out, axis_name)
+
+
+def moe_expert_parallel(
+    x: jnp.ndarray,
+    layer_params: dict,
+    cfg: ModelConfig,
+    mesh: Mesh,
+    axis: str = "ep",
+) -> jnp.ndarray:
+    """Layer params carry per-layer MoE weights (no layer axis):
+    router [D,E], w_gate/w_up [E,D,F], w_down [E,F,D]."""
+    ep = mesh.shape[axis]
+    if cfg.n_experts % ep != 0:
+        raise ValueError(f"ep={ep} must divide n_experts={cfg.n_experts}")
+    fn = partial(_moe_local, axis_name=axis, cfg=cfg)
+    expert_spec = P(axis, None, None)
+    return shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(P(), P(None, None), expert_spec, expert_spec, expert_spec),
+        out_specs=P(),
+    )(x, layer_params["router"], layer_params["w_gate"], layer_params["w_up"], layer_params["w_down"])

@@ -1,0 +1,82 @@
+"""KV-cache crash-resume (BASELINE.json config #3).
+
+The equivalence proof: snapshot a session's KV, destroy the engine, restore
+into a brand-new engine — the continuation must be TOKEN-IDENTICAL to an
+uninterrupted conversation. (Engines share weights via the same init seed,
+as restarted production engines share a checkpoint.)
+"""
+
+import asyncio
+
+import pytest
+
+from agentainer_tpu.engine.checkpoint import deserialize_kv_slot
+from agentainer_tpu.engine.llm import LLMEngine
+
+OPTS = {"max_batch": 2, "max_seq": 128, "decode_chunk": 4}
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_snapshot_restore_resumes_identically():
+    async def uninterrupted():
+        eng = LLMEngine.create("tiny", options=OPTS)
+        a = await eng.chat("s", "turn one", max_tokens=5)
+        b = await eng.chat("s", "turn two", max_tokens=5)
+        eng.shutdown()
+        return a, b
+
+    async def interrupted():
+        eng1 = LLMEngine.create("tiny", options=OPTS)
+        a = await eng1.chat("s", "turn one", max_tokens=5)
+        blob = eng1.snapshot_session("s")
+        assert blob is not None
+        eng1.shutdown()  # the crash
+
+        eng2 = LLMEngine.create("tiny", options=OPTS)
+        assert "s" not in eng2.sessions
+        assert await eng2.restore_session("s", blob) is True
+        b = await eng2.chat("s", "turn two", max_tokens=5)
+        eng2.shutdown()
+        return a, b, blob
+
+    ref_a, ref_b = run(uninterrupted())
+    got_a, got_b, blob = run(interrupted())
+    assert got_a["tokens"] == ref_a["tokens"]
+    assert got_b["tokens"] == ref_b["tokens"]  # the resume is exact
+
+    # snapshot is self-describing and compact (live prefix only)
+    k, v, header = deserialize_kv_slot(blob)
+    assert header["position"] == k.shape[1]
+    assert header["session"] == "s"
+    assert k.shape[1] < OPTS["max_seq"]
+
+
+def test_restore_rejects_oversized_snapshot():
+    async def body():
+        eng = LLMEngine.create("tiny", options=OPTS)
+        await eng.chat("s", "hello", max_tokens=4)
+        blob = eng.snapshot_session("s")
+        eng.shutdown()
+        # an engine with a smaller arena cannot hold the snapshot -> False
+        small = LLMEngine.create("tiny", options={"max_batch": 2, "max_seq": 8})
+        try:
+            k, v, header = deserialize_kv_slot(blob)
+            if header["position"] >= 7:
+                assert await small.restore_session("s", blob) is False
+            else:
+                assert await small.restore_session("s", blob) in (True, False)
+        finally:
+            small.shutdown()
+
+    run(body())
+
+
+def test_snapshot_unknown_session_is_none():
+    eng = LLMEngine.create("tiny", options=OPTS)
+    try:
+        assert eng.snapshot_session("nope") is None
+    finally:
+        eng.shutdown()

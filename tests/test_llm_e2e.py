@@ -103,3 +103,88 @@ def test_llm_agent_end_to_end(tmp_path):
             await client.close()
 
     asyncio.run(body())
+
+
+def test_llm_crash_resume_restores_kv_from_store(tmp_path):
+    """Kill the LLM engine process mid-conversation; the respawned engine
+    restores the session's KV snapshot from the control plane's store and
+    continues the conversation (kv_restores metric proves the path ran)."""
+
+    async def body():
+        cfg = Config()
+        cfg.auth_token = TOKEN
+        backend = LocalBackend(data_dir=str(tmp_path), ready_timeout_s=120.0)
+        services = build_services(
+            config=cfg,
+            store=MemoryStore(),
+            backend=backend,
+            console_logs=False,
+            data_dir=str(tmp_path),
+        )
+        client = TestClient(TestServer(services.app))
+        await client.start_server()
+        backend.set_control(f"http://127.0.0.1:{client.server.port}")
+        try:
+            resp = await client.post(
+                "/agents",
+                json={
+                    "name": "llm-resume",
+                    "model": {
+                        "engine": "llm",
+                        "config": "tiny",
+                        "options": {"max_batch": 2, "max_seq": 128, "decode_chunk": 4},
+                    },
+                    "env": {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
+                },
+                headers=AUTH,
+            )
+            agent = (await resp.json())["data"]
+            await client.post(f"/agents/{agent['id']}/start", headers=AUTH)
+
+            async def wait_loaded():
+                for _ in range(300):
+                    resp = await client.get(f"/agent/{agent['id']}/metrics")
+                    if (await resp.json()).get("model_loaded"):
+                        return
+                    await asyncio.sleep(0.2)
+                raise AssertionError("model never loaded")
+
+            await wait_loaded()
+            resp = await client.post(
+                f"/agent/{agent['id']}/chat",
+                data=json.dumps({"message": "turn one", "session": "s1", "max_tokens": 5}),
+            )
+            assert resp.status == 200, await resp.text()
+
+            # wait for the async KV snapshot to land in the store
+            kv_key = f"agent:{agent['id']}:kvcache:s1"
+            for _ in range(100):
+                if services.store.get(kv_key) is not None:
+                    break
+                await asyncio.sleep(0.05)
+            assert services.store.get(kv_key) is not None
+
+            # crash + resume (new engine process, fresh memory)
+            engine_id = services.manager.get_agent(agent["id"]).engine_id
+            backend.kill_engine_hard(engine_id)
+            services.quick_sync.sync_agent(agent["id"])
+            resp = await client.post(f"/agents/{agent['id']}/resume", headers=AUTH)
+            assert resp.status == 200, await resp.text()
+
+            await wait_loaded()
+            resp = await client.post(
+                f"/agent/{agent['id']}/chat",
+                data=json.dumps({"message": "turn two", "session": "s1", "max_tokens": 5}),
+            )
+            assert resp.status == 200, await resp.text()
+
+            # the respawned engine restored the session from the store
+            metrics = services.backend.stats(
+                services.manager.get_agent(agent["id"]).engine_id
+            )
+            assert metrics["kv_restores"] >= 1, metrics
+        finally:
+            backend.close()
+            await client.close()
+
+    asyncio.run(body())

@@ -77,14 +77,23 @@ class EchoEngine:
         reply = f"Echo: {message}"
         now = time.time()
         try:
-            await self.store.rpush(
-                self.convo_key,
-                json.dumps({"role": "user", "content": message, "ts": now}),
-                json.dumps({"role": "assistant", "content": reply, "ts": now}),
+            # one pipelined round-trip; rpush returns the post-push length so
+            # conversation_length needs no extra llen (ltrim caps it)
+            results = await self.store.pipeline(
+                [
+                    {
+                        "op": "rpush",
+                        "key": self.convo_key,
+                        "values": [
+                            json.dumps({"role": "user", "content": message, "ts": now}),
+                            json.dumps({"role": "assistant", "content": reply, "ts": now}),
+                        ],
+                    },
+                    {"op": "ltrim", "key": self.convo_key, "start": -2 * MAX_TURNS, "stop": -1},
+                    {"op": "hincrby", "key": self.metrics_key, "field": "chats", "amount": 1},
+                ]
             )
-            await self.store.ltrim(self.convo_key, -2 * MAX_TURNS, -1)
-            await self.store.hincrby(self.metrics_key, "chats", 1)
-            n = await self.store.llen(self.convo_key)
+            n = min(int(results[0]), 2 * MAX_TURNS)
         except Exception:
             n = -1  # store unreachable: still serve (availability over convo durability)
         return web.json_response(

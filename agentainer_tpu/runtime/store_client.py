@@ -40,9 +40,7 @@ class StoreClient:
             await self._session.close()
             self._session = None
 
-    async def _op(self, op: str, key: str, **kw: Any) -> Any:
-        if not self.connected:
-            return self._local_op(op, key, **kw)
+    async def _post(self, payload: dict[str, Any], label: str) -> Any:
         if self._session is None:
             self._session = aiohttp.ClientSession(
                 timeout=aiohttp.ClientTimeout(total=10),
@@ -52,12 +50,28 @@ class StoreClient:
                 },
             )
         async with self._session.post(
-            f"{self.control_url}/internal/store", json={"op": op, "key": key, **kw}
+            f"{self.control_url}/internal/store", json=payload
         ) as resp:
             doc = await resp.json()
             if resp.status != 200:
-                raise RuntimeError(f"store op {op} failed: {doc.get('message')}")
+                raise RuntimeError(f"store {label} failed: {doc.get('message')}")
             return doc.get("data")
+
+    async def _op(self, op: str, key: str, **kw: Any) -> Any:
+        if not self.connected:
+            return self._local_op(op, key, **kw)
+        return await self._post({"op": op, "key": key, **kw}, f"op {op}")
+
+    async def pipeline(self, ops: list[dict[str, Any]]) -> list[Any]:
+        """Run a batch of ops in one round-trip (each: {op, key, ...})."""
+        if not self.connected:
+            return [
+                self._local_op(
+                    o["op"], o["key"], **{k: v for k, v in o.items() if k not in ("op", "key")}
+                )
+                for o in ops
+            ]
+        return await self._post({"op": "pipeline", "ops": ops}, "pipeline") or []
 
     def _local_op(self, op: str, key: str, **kw: Any) -> Any:
         d = self._local

@@ -46,6 +46,21 @@ REQUEST_ID_HEADER = "X-Agentainer-Request-ID"
 DISPATCH_ENGINE_GONE = -1  # connection refused / engine vanished → stays pending
 DISPATCH_FAILED = -2  # timeout or protocol error → retry accounted
 
+_STORE_OPS = {
+    "get",
+    "set",
+    "set_b64",
+    "get_b64",
+    "delete",
+    "rpush",
+    "lrange",
+    "ltrim",
+    "llen",
+    "hincrby",
+    "hgetall",
+    "keys",
+}
+
 _HOP_BY_HOP = {
     "connection",
     "keep-alive",
@@ -355,51 +370,80 @@ class ControlPlaneApp:
             presented.encode(), expected
         ):
             return fail("invalid engine credentials", status=401)
+        store = self.s.store
+        ns = f"agent:{agent_id}:"
+        if body.get("op") == "pipeline":
+            # one round-trip for a batch of ops — the engine's per-chat
+            # conversation bookkeeping is 3-4 ops and used to cost 4 HTTP
+            # round-trips against the daemon loop. The whole batch is
+            # validated before anything executes so a rejected batch never
+            # partially applies.
+            ops = body.get("ops")
+            if not isinstance(ops, list) or not all(isinstance(o, dict) for o in ops):
+                return fail("pipeline ops must be a list of objects", status=400)
+            for sub in ops:
+                if not str(sub.get("key", "")).startswith(ns):
+                    return fail("key outside agent namespace", status=403)
+                if sub.get("op") not in _STORE_OPS:
+                    return fail(f"unknown op {sub.get('op')!r}", status=400)
+                pat = sub.get("pattern")
+                if pat is not None and not str(pat).startswith(ns):
+                    return fail("pattern outside agent namespace", status=403)
+            try:
+                return ok([self._store_op(store, ns, sub) for sub in ops])
+            except (TypeError, ValueError) as e:
+                return fail(str(e), status=400)
         op = body.get("op", "")
         key = body.get("key", "")
-        if not key.startswith(f"agent:{agent_id}:"):
+        if not key.startswith(ns):
             return fail("key outside agent namespace", status=403)
-        store = self.s.store
+        if op == "keys" and not str(body.get("pattern", key + "*")).startswith(ns):
+            return fail("pattern outside agent namespace", status=403)
         try:
-            if op == "get":
-                raw = store.get(key)
-                return ok(None if raw is None else raw.decode("utf-8", "replace"))
-            if op == "set":
-                store.set(key, body.get("value", ""), ttl=body.get("ttl"))
-                return ok()
-            if op == "set_b64":
-                import base64 as _b64
-
-                store.set(key, _b64.b64decode(body.get("value_b64", "")), ttl=body.get("ttl"))
-                return ok()
-            if op == "get_b64":
-                import base64 as _b64
-
-                raw = store.get(key)
-                return ok(None if raw is None else _b64.b64encode(raw).decode())
-            if op == "delete":
-                return ok(store.delete(key))
-            if op == "rpush":
-                return ok(store.rpush(key, *[v for v in body.get("values", [])]))
-            if op == "lrange":
-                return ok(store.lrange_str(key, body.get("start", 0), body.get("stop", -1)))
-            if op == "ltrim":
-                store.ltrim(key, body.get("start", 0), body.get("stop", -1))
-                return ok()
-            if op == "llen":
-                return ok(store.llen(key))
-            if op == "hincrby":
-                return ok(store.hincrby(key, body.get("field", ""), body.get("amount", 1)))
-            if op == "hgetall":
-                return ok({k: v.decode("utf-8", "replace") for k, v in store.hgetall(key).items()})
-            if op == "keys":
-                pat = body.get("pattern", key + "*")
-                if not pat.startswith(f"agent:{agent_id}:"):
-                    return fail("pattern outside agent namespace", status=403)
-                return ok(store.keys(pat))
-            return fail(f"unknown op {op!r}", status=400)
-        except TypeError as e:
+            return ok(self._store_op(store, ns, body))
+        except (TypeError, ValueError) as e:
             return fail(str(e), status=400)
+
+    @staticmethod
+    def _store_op(store, ns: str, body: dict):
+        """Execute one namespace-checked store op; raises ValueError on bad
+        input. Callers enforce key/pattern namespacing before execution."""
+        op = body.get("op", "")
+        key = body.get("key", "")
+        if op == "get":
+            raw = store.get(key)
+            return None if raw is None else raw.decode("utf-8", "replace")
+        if op == "set":
+            store.set(key, body.get("value", ""), ttl=body.get("ttl"))
+            return None
+        if op == "set_b64":
+            import base64 as _b64
+
+            store.set(key, _b64.b64decode(body.get("value_b64", "")), ttl=body.get("ttl"))
+            return None
+        if op == "get_b64":
+            import base64 as _b64
+
+            raw = store.get(key)
+            return None if raw is None else _b64.b64encode(raw).decode()
+        if op == "delete":
+            return store.delete(key)
+        if op == "rpush":
+            return store.rpush(key, *[v for v in body.get("values", [])])
+        if op == "lrange":
+            return store.lrange_str(key, body.get("start", 0), body.get("stop", -1))
+        if op == "ltrim":
+            store.ltrim(key, body.get("start", 0), body.get("stop", -1))
+            return None
+        if op == "llen":
+            return store.llen(key)
+        if op == "hincrby":
+            return store.hincrby(key, body.get("field", ""), body.get("amount", 1))
+        if op == "hgetall":
+            return {k: v.decode("utf-8", "replace") for k, v in store.hgetall(key).items()}
+        if op == "keys":
+            return store.keys(body.get("pattern", key + "*"))
+        raise ValueError(f"unknown op {op!r}")
 
     # -- backups ---------------------------------------------------------
     async def h_backup_create(self, request: web.Request) -> web.Response:

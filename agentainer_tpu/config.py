@@ -36,6 +36,9 @@ class SliceConfig:
 class FeatureFlags:
     request_persistence: bool = True  # config.go:70
     auto_restart_default: bool = False
+    # Serve /agent/* + the engine store socket from the C++ data plane when
+    # the native library is available (falls back to the aiohttp proxy).
+    native_dataplane: bool = True
 
 
 @dataclass
@@ -55,7 +58,9 @@ class Config:
     features: FeatureFlags = field(default_factory=FeatureFlags)
     cadences: Cadences = field(default_factory=Cadences)
     auth_token: str = DEFAULT_TOKEN
-    store_url: str = "mem://"
+    # "auto": native C++ store with AOF durability when the library builds,
+    # in-memory store otherwise. Explicit: mem:// | native://[aof-path]
+    store_url: str = "auto"
     data_dir: str = "~/.agentainer_tpu"
 
     @property
@@ -104,6 +109,15 @@ def load_config(path: str | None = None) -> Config:
         cfg.slice.total_chips = int(env["ATPU_SLICE_CHIPS"])
     if "ATPU_REQUEST_PERSISTENCE" in env:
         cfg.features.request_persistence = env["ATPU_REQUEST_PERSISTENCE"].lower() in (
+            "1",
+            "true",
+            "yes",
+        )
+    cfg.features.native_dataplane = bool(
+        feats.get("native_dataplane", cfg.features.native_dataplane)
+    )
+    if "ATPU_NATIVE_DATAPLANE" in env:
+        cfg.features.native_dataplane = env["ATPU_NATIVE_DATAPLANE"].lower() in (
             "1",
             "true",
             "yes",

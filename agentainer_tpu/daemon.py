@@ -40,11 +40,14 @@ class Services:
     logs: LogPlane
     metrics: MetricsPlane
     backups: BackupManager
+    data_dir: str = ""
     health: HealthMonitor = None  # type: ignore[assignment]
     quick_sync: QuickSync = None  # type: ignore[assignment]
     state_sync: StateSynchronizer = None  # type: ignore[assignment]
     replay: ReplayWorker = None  # type: ignore[assignment]
     dispatch: Callable[..., Awaitable[tuple[int, dict, bytes]]] = None  # type: ignore[assignment]
+    dataplane: object = None  # NativeDataPlane when the C++ listener is up
+    public_port: int = 0  # actual bound public port once run_daemon is up
     _background_started: bool = field(default=False, repr=False)
 
 
@@ -56,7 +59,22 @@ def build_services(
     data_dir: str | None = None,
 ) -> Services:
     config = config or load_config()
-    store = store or open_store(config.store_url)
+    ddir = data_dir if data_dir is not None else config.data_path
+    if store is None:
+        url = config.store_url
+        if url == "auto":
+            # native store + AOF durability when the library builds — the
+            # Redis-persistence role in the reference; memory store otherwise
+            from .native import available as native_available
+
+            if native_available():
+                import os as _os
+
+                _os.makedirs(str(ddir), exist_ok=True)
+                url = f"native://{ddir}/store.aof"
+            else:
+                url = "mem://"
+        store = open_store(url)
     if backend is None:
         from .runtime.local import LocalBackend
 
@@ -71,7 +89,6 @@ def build_services(
     scheduler = SliceScheduler(store, topo)
     manager = AgentManager(store, backend, scheduler)
     journal = RequestJournal(store)
-    ddir = data_dir if data_dir is not None else config.data_path
     logs = LogPlane(store, data_dir=ddir, console=console_logs)
     metrics = MetricsPlane(manager, store, interval_s=config.cadences.metrics_interval_s)
     backups = BackupManager(manager, store, ddir)
@@ -86,6 +103,7 @@ def build_services(
         logs=logs,
         metrics=metrics,
         backups=backups,
+        data_dir=str(ddir),
     )
 
     quick_sync = QuickSync(manager, backend)
@@ -133,26 +151,100 @@ async def stop_background(services: Services) -> None:
     await services.health.stop()
 
 
+def _try_start_dataplane(services: Services, mgmt_port: int):
+    """Start the C++ front door on the public port: /agent/* and the engine
+    store socket served natively, management forwarded to aiohttp on
+    ``mgmt_port``. Returns the NativeDataPlane or None (pure-Python mode)."""
+    cfg = services.config
+    if not cfg.features.native_dataplane:
+        return None
+    from .store.native import NativeStore
+
+    if not isinstance(services.store, NativeStore):
+        return None
+    try:
+        import os as _os
+
+        from .runtime.dataplane import NativeDataPlane
+
+        _os.makedirs(services.data_dir, exist_ok=True)
+        uds_path = str(_os.path.join(services.data_dir, "store.sock"))
+        dp = NativeDataPlane(
+            services.store,
+            cfg.server.host,
+            cfg.server.port,
+            "127.0.0.1",
+            mgmt_port,
+            uds_path,
+        )
+    except Exception as e:
+        services.logs.warn("daemon", f"native data plane unavailable: {e}")
+        return None
+
+    persist = cfg.features.request_persistence
+
+    def route_hook(agent, agent_id: str) -> None:
+        if agent is None:
+            dp.route_del(agent_id)
+        else:
+            dp.route_set(
+                agent_id,
+                services.manager.endpoint(agent),
+                agent.status.value,
+                persist,
+            )
+
+    services.manager.set_route_hook(route_hook)
+    services.metrics.set_native_drain(dp.counters_drain)
+    if hasattr(services.backend, "set_store_sock"):
+        services.backend.set_store_sock(uds_path)
+    services.dataplane = dp
+    return dp
+
+
 async def run_daemon(services: Services) -> None:
     """Serve until cancelled (SIGINT/SIGTERM handling lives in the CLI)."""
     runner = web.AppRunner(services.app)  # type: ignore[attr-defined]
     await runner.setup()
-    site = web.TCPSite(runner, services.config.server.host, services.config.server.port)
+    cfg = services.config
+    # With the native data plane, aiohttp binds an internal loopback port and
+    # the C++ listener owns the public one; otherwise aiohttp is the front.
+    site = web.TCPSite(runner, "127.0.0.1", 0)
     await site.start()
+    mgmt_port = runner.addresses[0][1]
+    dp = _try_start_dataplane(services, mgmt_port)
+    if dp is None:
+        public_site = web.TCPSite(runner, cfg.server.host, cfg.server.port)
+        await public_site.start()
+        public_port = cfg.server.port
+        if public_port == 0:  # ephemeral: resolve what the kernel picked
+            public_port = public_site._server.sockets[0].getsockname()[1]
+    else:
+        public_port = dp.port  # differs from config when port 0 = ephemeral
+    services.public_port = public_port
     if hasattr(services.backend, "set_control"):
         services.backend.set_control(
-            f"http://127.0.0.1:{services.config.server.port}", services.config.auth_token
+            f"http://127.0.0.1:{public_port}", services.config.auth_token
         )
     await start_background(services)
     services.logs.info(
         "daemon",
-        f"control plane listening on {services.config.server.host}:"
-        f"{services.config.server.port} (slice {services.scheduler.topology.name})",
+        f"control plane listening on {cfg.server.host}:{public_port} "
+        f"(slice {services.scheduler.topology.name}, "
+        f"data plane {'native' if dp else 'python'})",
     )
     try:
         while True:
             await asyncio.sleep(3600)
     finally:
-        await stop_background(services)
+        # a cancellation landing inside stop_background's awaits must not
+        # skip dp.stop(): the data plane references the store, which the
+        # owner may free right after run_daemon returns
+        try:
+            await stop_background(services)
+        except asyncio.CancelledError:
+            pass
+        if dp is not None:
+            dp.stop()
         services.backend.close()
         await runner.cleanup()

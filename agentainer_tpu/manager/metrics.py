@@ -31,6 +31,13 @@ class MetricsPlane:
         self._lock = threading.Lock()
         self._counters: dict[str, dict] = {}
         self._task: asyncio.Task | None = None
+        # native data plane's per-agent request counters (drained per sample)
+        self._native_drain = None
+
+    def set_native_drain(self, drain) -> None:
+        """``drain(agent_id) -> {requests, latency_sum, latency_max}`` from
+        the C++ proxy; merged with Python-side counters at sample time."""
+        self._native_drain = drain
 
     # -- proxy-side accounting ------------------------------------------
     def count_request(self, agent_id: str, latency_s: float = 0.0) -> None:
@@ -45,7 +52,16 @@ class MetricsPlane:
     def _drain_counters(self, agent_id: str) -> dict:
         with self._lock:
             c = self._counters.pop(agent_id, None)
-        if not c or not c["requests"]:
+        c = c or {"requests": 0, "latency_sum": 0.0, "latency_max": 0.0}
+        if self._native_drain is not None:
+            try:
+                n = self._native_drain(agent_id)
+                c["requests"] += n["requests"]
+                c["latency_sum"] += n["latency_sum"]
+                c["latency_max"] = max(c["latency_max"], n["latency_max"])
+            except Exception:
+                pass
+        if not c["requests"]:
             return {"requests": 0, "latency_avg_s": 0.0, "latency_max_s": 0.0}
         return {
             "requests": c["requests"],

@@ -1,0 +1,141 @@
+"""Loader for the native layer (libagentainer_native.so).
+
+Builds on first use via ``make -C native`` (g++ is part of the baked
+toolchain) and caches the result. Everything degrades gracefully: callers
+check ``available()`` and fall back to the pure-Python store / aiohttp proxy
+when the library can't be built (e.g. no compiler on a user machine).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent
+_NATIVE_DIR = _REPO_ROOT / "native"
+_LIB_PATH = _NATIVE_DIR / "build" / "libagentainer_native.so"
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_error: str | None = None
+
+
+def _build() -> bool:
+    try:
+        proc = subprocess.run(
+            ["make", "-C", str(_NATIVE_DIR)],
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        return proc.returncode == 0 and _LIB_PATH.exists()
+    except Exception:
+        return False
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    c = ctypes
+    lib.atpu_store_new.restype = c.c_void_p
+    lib.atpu_store_new.argtypes = [c.c_char_p]
+    lib.atpu_store_free.argtypes = [c.c_void_p]
+    lib.atpu_free.argtypes = [c.c_void_p]
+    lib.atpu_cmd.restype = c.c_int
+    lib.atpu_cmd.argtypes = [
+        c.c_void_p,
+        c.c_char_p,
+        c.c_size_t,
+        c.POINTER(c.POINTER(c.c_uint8)),
+        c.POINTER(c.c_size_t),
+    ]
+    lib.atpu_subscribe.restype = c.c_uint64
+    lib.atpu_subscribe.argtypes = [c.c_void_p, c.c_char_p, c.c_size_t]
+    lib.atpu_sub_poll.restype = c.c_int
+    lib.atpu_sub_poll.argtypes = [
+        c.c_void_p,
+        c.c_uint64,
+        c.c_int,
+        c.POINTER(c.POINTER(c.c_uint8)),
+        c.POINTER(c.c_size_t),
+    ]
+    lib.atpu_sub_close.argtypes = [c.c_void_p, c.c_uint64]
+    lib.atpu_publish.restype = c.c_int
+    lib.atpu_publish.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p, c.c_size_t]
+    lib.atpu_aof_flush.argtypes = [c.c_void_p]
+    lib.atpu_dp_start.restype = c.c_void_p
+    lib.atpu_dp_start.argtypes = [
+        c.c_void_p,
+        c.c_char_p,
+        c.c_int,
+        c.c_char_p,
+        c.c_int,
+        c.c_char_p,
+    ]
+    lib.atpu_dp_port.restype = c.c_int
+    lib.atpu_dp_port.argtypes = [c.c_void_p]
+    lib.atpu_dp_stop.argtypes = [c.c_void_p]
+    lib.atpu_dp_route_set.argtypes = [
+        c.c_void_p,
+        c.c_char_p,
+        c.c_char_p,
+        c.c_int,
+        c.c_char_p,
+        c.c_int,
+    ]
+    lib.atpu_dp_route_del.argtypes = [c.c_void_p, c.c_char_p]
+    lib.atpu_dp_counters_drain.argtypes = [
+        c.c_void_p,
+        c.c_char_p,
+        c.POINTER(c.c_uint64),
+        c.POINTER(c.c_double),
+        c.POINTER(c.c_double),
+    ]
+
+
+def load() -> ctypes.CDLL | None:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _load_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _load_error is not None:
+            return None
+        if not _LIB_PATH.exists() or _stale():
+            if not _build():
+                _load_error = "native build failed (make -C native)"
+                return None
+        try:
+            lib = ctypes.CDLL(str(_LIB_PATH))
+            _bind(lib)
+            _lib = lib
+            return lib
+        except OSError as e:
+            _load_error = f"dlopen failed: {e}"
+            return None
+
+
+def _stale() -> bool:
+    """Rebuild when any source is newer than the library."""
+    try:
+        lib_mtime = _LIB_PATH.stat().st_mtime
+        for src in _NATIVE_DIR.glob("*.cc"):
+            if src.stat().st_mtime > lib_mtime:
+                return True
+        for src in _NATIVE_DIR.glob("*.h"):
+            if src.stat().st_mtime > lib_mtime:
+                return True
+        return False
+    except OSError:
+        return True
+
+
+def available() -> bool:
+    if os.environ.get("ATPU_DISABLE_NATIVE", "") == "1":
+        return False
+    return load() is not None
+
+
+def load_error() -> str | None:
+    return _load_error

@@ -1,0 +1,89 @@
+"""Python handle for the native data plane (native/dataplane.cc).
+
+The daemon starts the C++ listener on the public port; Python keeps policy
+(lifecycle, replay, health) and feeds the routing table on every agent
+mutation. Agent traffic then flows entirely on native threads: journal →
+engine dispatch → settle, with zero Python in the loop. Management paths are
+transparently forwarded to the aiohttp server on its internal port.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from urllib.parse import urlparse
+
+from ..native import load
+
+
+class NativeDataPlane:
+    def __init__(
+        self,
+        store,  # NativeStore — shares its C handle with the listener
+        listen_host: str,
+        listen_port: int,
+        backend_host: str,
+        backend_port: int,
+        uds_path: str = "",
+    ):
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self._store = store  # keep alive: dp threads use its handle
+        self._handle = self._lib.atpu_dp_start(
+            store.handle,
+            listen_host.encode(),
+            listen_port,
+            backend_host.encode(),
+            backend_port,
+            uds_path.encode() if uds_path else None,
+        )
+        if not self._handle:
+            raise RuntimeError(f"data plane failed to bind port {listen_port}")
+        self.uds_path = uds_path
+
+    @property
+    def port(self) -> int:
+        return self._lib.atpu_dp_port(self._handle)
+
+    def route_set(
+        self, agent_id: str, endpoint: str | None, status: str, persist: bool
+    ) -> None:
+        """Update an agent's route. ``endpoint`` is the engine URL
+        (http://127.0.0.1:PORT) or None when no engine is live."""
+        host, port = "127.0.0.1", 0
+        if endpoint:
+            u = urlparse(endpoint)
+            host, port = u.hostname or "127.0.0.1", u.port or 80
+        self._lib.atpu_dp_route_set(
+            self._handle,
+            agent_id.encode(),
+            host.encode(),
+            port,
+            status.encode(),
+            1 if persist else 0,
+        )
+
+    def route_del(self, agent_id: str) -> None:
+        self._lib.atpu_dp_route_del(self._handle, agent_id.encode())
+
+    def counters_drain(self, agent_id: str) -> dict:
+        requests = ctypes.c_uint64()
+        lat_sum = ctypes.c_double()
+        lat_max = ctypes.c_double()
+        self._lib.atpu_dp_counters_drain(
+            self._handle,
+            agent_id.encode(),
+            ctypes.byref(requests),
+            ctypes.byref(lat_sum),
+            ctypes.byref(lat_max),
+        )
+        return {
+            "requests": requests.value,
+            "latency_sum": lat_sum.value,
+            "latency_max": lat_max.value,
+        }
+
+    def stop(self) -> None:
+        if self._handle:
+            self._lib.atpu_dp_stop(self._handle)
+            self._handle = None

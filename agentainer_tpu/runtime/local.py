@@ -76,6 +76,7 @@ class LocalBackend(Backend):
         self.python = python
         self.ready_timeout_s = ready_timeout_s
         self.control_url = ""
+        self.store_sock = ""
         self.internal_token = ""
         self._dir = Path(data_dir or tempfile.mkdtemp(prefix="atpu-engines-")).expanduser()
         (self._dir / "engines").mkdir(parents=True, exist_ok=True)
@@ -94,6 +95,12 @@ class LocalBackend(Backend):
         the admin bearer token.
         """
         self.control_url = url
+
+    def set_store_sock(self, uds_path: str) -> None:
+        """Point engines at the native store's unix socket (binary protocol,
+        bypasses HTTP for state ops); engines fall back to the HTTP store API
+        when unset."""
+        self.store_sock = uds_path
 
     # -- backend interface ----------------------------------------------
     def create_engine(self, agent: Agent, chips: tuple[int, ...]) -> str:
@@ -162,6 +169,7 @@ class LocalBackend(Backend):
                 pass
         rec.log_file = open(rec.log_path, "ab")
         rec.env["AGENTAINER_CONTROL_URL"] = self.control_url
+        rec.env["AGENTAINER_STORE_SOCK"] = self.store_sock
         rec.proc = subprocess.Popen(
             rec.cmd,
             env=rec.env,

@@ -2,26 +2,156 @@
 
 The reference's agents connect to Redis directly over the bridge network
 (examples/gpt-agent/app.py:20-27). Engines here reach the daemon's store
-through the authenticated ``/internal/store`` endpoint, namespaced to their
-own ``agent:{id}:*`` keys. Falls back to process-local memory when no
-control URL is configured (standalone engine runs, unit tests).
+two ways, fastest available first:
+
+- **unix socket, binary protocol** (``AGENTAINER_STORE_SOCK``): frames of
+  the native wire encoding (native/common.h) straight into the C++ store —
+  no HTTP, no JSON, authenticated once per connection with the per-engine
+  token;
+- **HTTP** (``AGENTAINER_CONTROL_URL`` + ``/internal/store``): JSON ops,
+  namespaced to the agent's ``agent:{id}:*`` keys.
+
+Falls back to process-local memory when neither is configured (standalone
+engine runs, unit tests).
 """
 
 from __future__ import annotations
 
+import asyncio
 import os
+import struct
 from typing import Any
 
 import aiohttp
 
+# opcode mirror of native/common.h (subset engines use)
+_OP_NUM = {
+    "set": 1,
+    "get": 2,
+    "delete": 3,
+    "keys": 5,
+    "expire": 6,
+    "ttl": 7,
+    "rpush": 11,
+    "lpush": 12,
+    "lrem": 13,
+    "lrange": 14,
+    "llen": 15,
+    "ltrim": 16,
+    "hset": 21,
+    "hincrby": 22,
+    "hgetall": 23,
+    "pipeline": 26,
+    "auth": 27,
+}
+
+
+def _enc(op: int, args: list[bytes]) -> bytes:
+    out = [struct.pack("<BI", op, len(args))]
+    for a in args:
+        out.append(struct.pack("<I", len(a)) + a)
+    return b"".join(out)
+
+
+def _dec(buf: bytes) -> tuple[int, list[bytes]]:
+    status = buf[0]
+    (count,) = struct.unpack_from("<I", buf, 1)
+    vals, pos = [], 5
+    for _ in range(count):
+        (alen,) = struct.unpack_from("<I", buf, pos)
+        pos += 4
+        vals.append(buf[pos : pos + alen])
+        pos += alen
+    return status, vals
+
+
+class _UDSPool:
+    """Small pool of authenticated unix-socket connections to the native
+    store; one frame in flight per connection."""
+
+    def __init__(self, path: str, agent_id: str, token: str, size: int = 4):
+        self.path = path
+        self.agent_id = agent_id
+        self.token = token
+        self.size = size
+        self._free: asyncio.Queue | None = None
+        self._made = 0
+        self._lock = asyncio.Lock()
+
+    async def _connect(self):
+        reader, writer = await asyncio.open_unix_connection(self.path)
+        frame = _enc(_OP_NUM["auth"], [self.agent_id.encode(), self.token.encode()])
+        writer.write(struct.pack("<I", len(frame)) + frame)
+        await writer.drain()
+        status, vals = await self._read_resp(reader)
+        if status != 0:
+            writer.close()
+            raise RuntimeError(
+                f"store auth failed: {vals[0].decode() if vals else 'unknown'}"
+            )
+        return reader, writer
+
+    @staticmethod
+    async def _read_resp(reader) -> tuple[int, list[bytes]]:
+        raw_len = await reader.readexactly(4)
+        (n,) = struct.unpack("<I", raw_len)
+        return _dec(await reader.readexactly(n))
+
+    async def roundtrip(self, frame: bytes) -> tuple[int, list[bytes]]:
+        if self._free is None:
+            async with self._lock:
+                if self._free is None:
+                    self._free = asyncio.Queue()
+        conn = None
+        if self._free.empty() and self._made < self.size:
+            async with self._lock:
+                if self._made < self.size:
+                    self._made += 1
+                    try:
+                        conn = await self._connect()
+                    except Exception:
+                        self._made -= 1
+                        raise
+        if conn is None:
+            conn = await self._free.get()
+        reader, writer = conn
+        try:
+            writer.write(struct.pack("<I", len(frame)) + frame)
+            await writer.drain()
+            resp = await self._read_resp(reader)
+        except Exception:
+            self._made -= 1
+            writer.close()
+            raise
+        self._free.put_nowait(conn)
+        return resp
+
+    def close(self) -> None:
+        if self._free is None:
+            return
+        while not self._free.empty():
+            _, writer = self._free.get_nowait()
+            writer.close()
+
 
 class StoreClient:
-    def __init__(self, control_url: str = "", token: str = "", agent_id: str = ""):
+    def __init__(
+        self,
+        control_url: str = "",
+        token: str = "",
+        agent_id: str = "",
+        store_sock: str = "",
+    ):
         self.control_url = control_url.rstrip("/")
         self.token = token
         self.agent_id = agent_id
         self._session: aiohttp.ClientSession | None = None
         self._local: dict[str, Any] = {}  # fallback when no control plane
+        self._uds = (
+            _UDSPool(store_sock, agent_id, token)
+            if store_sock and agent_id and token
+            else None
+        )
 
     @classmethod
     def from_env(cls) -> "StoreClient":
@@ -29,16 +159,19 @@ class StoreClient:
             control_url=os.environ.get("AGENTAINER_CONTROL_URL", ""),
             token=os.environ.get("AGENTAINER_INTERNAL_TOKEN", ""),
             agent_id=os.environ.get("AGENTAINER_AGENT_ID", ""),
+            store_sock=os.environ.get("AGENTAINER_STORE_SOCK", ""),
         )
 
     @property
     def connected(self) -> bool:
-        return bool(self.control_url)
+        return bool(self.control_url) or self._uds is not None
 
     async def close(self) -> None:
         if self._session is not None:
             await self._session.close()
             self._session = None
+        if self._uds is not None:
+            self._uds.close()
 
     async def _post(self, payload: dict[str, Any], label: str) -> Any:
         if self._session is None:
@@ -57,13 +190,101 @@ class StoreClient:
                 raise RuntimeError(f"store {label} failed: {doc.get('message')}")
             return doc.get("data")
 
+    # -- binary encoding of the HTTP op shapes ---------------------------
+    @staticmethod
+    def _encode_sub(op: str, key: str, kw: dict) -> bytes:
+        import base64 as _b64
+
+        k = key.encode()
+        if op == "get" or op == "get_b64":
+            return _enc(_OP_NUM["get"], [k])
+        if op == "set":
+            ttl = kw.get("ttl")
+            return _enc(
+                _OP_NUM["set"],
+                [k, str(kw.get("value", "")).encode(), b"" if ttl is None else repr(float(ttl)).encode()],
+            )
+        if op == "set_b64":
+            ttl = kw.get("ttl")
+            return _enc(
+                _OP_NUM["set"],
+                [k, _b64.b64decode(kw.get("value_b64", "")), b"" if ttl is None else repr(float(ttl)).encode()],
+            )
+        if op == "delete":
+            return _enc(_OP_NUM["delete"], [k])
+        if op == "rpush":
+            return _enc(_OP_NUM["rpush"], [k] + [str(v).encode() for v in kw.get("values", [])])
+        if op == "lrange":
+            return _enc(
+                _OP_NUM["lrange"],
+                [k, str(kw.get("start", 0)).encode(), str(kw.get("stop", -1)).encode()],
+            )
+        if op == "ltrim":
+            return _enc(
+                _OP_NUM["ltrim"],
+                [k, str(kw.get("start", 0)).encode(), str(kw.get("stop", -1)).encode()],
+            )
+        if op == "llen":
+            return _enc(_OP_NUM["llen"], [k])
+        if op == "hincrby":
+            return _enc(
+                _OP_NUM["hincrby"],
+                [k, str(kw.get("field", "")).encode(), str(kw.get("amount", 1)).encode()],
+            )
+        if op == "hgetall":
+            return _enc(_OP_NUM["hgetall"], [k])
+        if op == "keys":
+            return _enc(_OP_NUM["keys"], [str(kw.get("pattern", key + "*")).encode()])
+        raise ValueError(f"op {op!r} not supported over the store socket")
+
+    @staticmethod
+    def _decode_result(op: str, status: int, vals: list[bytes]) -> Any:
+        import base64 as _b64
+
+        if status == 1:
+            raise RuntimeError(vals[0].decode("utf-8", "replace") if vals else "store error")
+        if status == 2:  # nil
+            return None
+        if op == "get":
+            return vals[0].decode("utf-8", "replace") if vals else None
+        if op == "get_b64":
+            return _b64.b64encode(vals[0]).decode() if vals else None
+        if op in ("delete", "rpush", "llen", "hincrby", "lrem"):
+            return int(vals[0]) if vals else 0
+        if op in ("lrange", "keys"):
+            return [v.decode("utf-8", "replace") for v in vals]
+        if op == "hgetall":
+            return {
+                vals[i].decode("utf-8", "replace"): vals[i + 1].decode("utf-8", "replace")
+                for i in range(0, len(vals), 2)
+            }
+        return None  # set/ltrim/set_b64
+
     async def _op(self, op: str, key: str, **kw: Any) -> Any:
+        if self._uds is not None:
+            status, vals = await self._uds.roundtrip(self._encode_sub(op, key, kw))
+            return self._decode_result(op, status, vals)
         if not self.connected:
             return self._local_op(op, key, **kw)
         return await self._post({"op": op, "key": key, **kw}, f"op {op}")
 
     async def pipeline(self, ops: list[dict[str, Any]]) -> list[Any]:
         """Run a batch of ops in one round-trip (each: {op, key, ...})."""
+        if self._uds is not None:
+            subs = [
+                self._encode_sub(
+                    o["op"], o["key"], {k: v for k, v in o.items() if k not in ("op", "key")}
+                )
+                for o in ops
+            ]
+            status, vals = await self._uds.roundtrip(_enc(_OP_NUM["pipeline"], subs))
+            if status != 0:
+                raise RuntimeError(
+                    vals[0].decode("utf-8", "replace") if vals else "pipeline failed"
+                )
+            return [
+                self._decode_result(o["op"], *_dec(raw)) for o, raw in zip(ops, vals)
+            ]
         if not self.connected:
             return [
                 self._local_op(

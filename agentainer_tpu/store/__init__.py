@@ -26,8 +26,10 @@ def open_store(url: str | None = None) -> Store:
     """Open a store from a URL.
 
     ``mem://`` (default) → in-process MemoryStore;
-    ``native://`` → C++ store (falls back to MemoryStore if the shared
-    library has not been built);
+    ``native://`` → C++ store; ``native:///abs/path.aof`` additionally
+    persists every mutation to an append-only file replayed on reopen
+    (the durability Redis gave the reference). Falls back to MemoryStore
+    if the shared library can't be built;
     ``redis://host:port`` → real Redis, if the ``redis`` package is present
     (it is not baked into the TPU-VM image, so this is gated).
     """
@@ -37,7 +39,8 @@ def open_store(url: str | None = None) -> Store:
         try:
             from .native import NativeStore
 
-            return NativeStore()
+            aof = url[len("native://") :]
+            return NativeStore(aof_path=aof or None)
         except Exception:
             return MemoryStore()
     if url.startswith("redis://"):

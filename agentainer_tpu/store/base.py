@@ -169,8 +169,10 @@ class Store(ABC):
     def on_message(self, pattern: str, callback: Callable[[str, str], None]) -> Callable[[], None]:
         """Register a callback for a pattern; returns an unregister function.
 
-        Callbacks run synchronously on the publisher's thread — asyncio
-        consumers should bounce to their loop via ``call_soon_threadsafe``.
+        Callbacks run on an arbitrary thread (the publisher's for the memory
+        store, a poller thread for the native store) and may be delivered
+        asynchronously — asyncio consumers should bounce to their loop via
+        ``call_soon_threadsafe`` and must not assume delivery-before-return.
         """
 
     # -- lifecycle -------------------------------------------------------

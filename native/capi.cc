@@ -1,0 +1,138 @@
+// C ABI for the native layer — consumed by agentainer_tpu/store/native.py
+// (ctypes) and agentainer_tpu/runtime/dataplane.py. All buffers returned via
+// out-params are heap-allocated with malloc and must be freed with atpu_free.
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "common.h"
+#include "dataplane.h"
+#include "store.h"
+
+using atpu::Request;
+using atpu::Store;
+
+namespace {
+
+// Copy a std::string into a malloc'd buffer for the Python side.
+uint8_t* to_heap(const std::string& s, size_t* len) {
+  *len = s.size();
+  uint8_t* p = static_cast<uint8_t*>(std::malloc(s.size() ? s.size() : 1));
+  if (s.size()) std::memcpy(p, s.data(), s.size());
+  return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* atpu_store_new(const char* aof_path) {
+  return new Store(aof_path ? aof_path : "");
+}
+
+void atpu_store_free(void* h) { delete static_cast<Store*>(h); }
+
+void atpu_free(void* p) { std::free(p); }
+
+// Execute one encoded command; returns 0 and fills *resp/*resp_len.
+int atpu_cmd(void* h, const uint8_t* req_buf, size_t req_len, uint8_t** resp,
+             size_t* resp_len) {
+  Request req;
+  std::string out;
+  if (!atpu::parse_request(req_buf, req_len, &req)) {
+    out = atpu::resp_err("malformed request");
+  } else {
+    out = static_cast<Store*>(h)->execute(req);
+  }
+  *resp = to_heap(out, resp_len);
+  return 0;
+}
+
+uint64_t atpu_subscribe(void* h, const uint8_t* patterns_buf, size_t len) {
+  // patterns_buf: [u32 count]([u32 len][bytes])*
+  std::vector<std::string> patterns;
+  if (len >= 4) {
+    uint32_t count = atpu::get_u32(patterns_buf);
+    size_t pos = 4;
+    for (uint32_t i = 0; i < count && pos + 4 <= len; i++) {
+      uint32_t plen = atpu::get_u32(patterns_buf + pos);
+      pos += 4;
+      if (pos + plen > len) break;
+      patterns.emplace_back(reinterpret_cast<const char*>(patterns_buf + pos), plen);
+      pos += plen;
+    }
+  }
+  return static_cast<Store*>(h)->subscribe(patterns);
+}
+
+// Returns 1 (message: *resp = [u32 chan_len][chan][msg]), 0 (timeout),
+// -1 (closed/unknown sub).
+int atpu_sub_poll(void* h, uint64_t sub_id, int timeout_ms, uint8_t** resp,
+                  size_t* resp_len) {
+  std::string channel, message;
+  int rc = static_cast<Store*>(h)->sub_poll(sub_id, timeout_ms, &channel, &message);
+  if (rc == 1) {
+    std::string out;
+    atpu::put_arg(out, channel);
+    out += message;
+    *resp = to_heap(out, resp_len);
+  } else {
+    *resp = nullptr;
+    *resp_len = 0;
+  }
+  return rc;
+}
+
+void atpu_sub_close(void* h, uint64_t sub_id) {
+  static_cast<Store*>(h)->sub_close(sub_id);
+}
+
+int atpu_publish(void* h, const char* channel, const uint8_t* msg, size_t msg_len) {
+  return static_cast<Store*>(h)->publish(
+      channel, std::string(reinterpret_cast<const char*>(msg), msg_len));
+}
+
+void atpu_aof_flush(void* h) { static_cast<Store*>(h)->aof_flush(); }
+
+// ---- data plane ------------------------------------------------------------
+
+void* atpu_dp_start(void* store, const char* listen_host, int listen_port,
+                    const char* backend_host, int backend_port,
+                    const char* uds_path) {
+  auto* dp = new atpu::DataPlane(static_cast<Store*>(store),
+                                 listen_host ? listen_host : "", listen_port,
+                                 backend_host ? backend_host : "127.0.0.1",
+                                 backend_port, uds_path ? uds_path : "");
+  if (!dp->start()) {
+    delete dp;
+    return nullptr;
+  }
+  return dp;
+}
+
+int atpu_dp_port(void* dp) { return static_cast<atpu::DataPlane*>(dp)->port(); }
+
+void atpu_dp_stop(void* dp) {
+  auto* p = static_cast<atpu::DataPlane*>(dp);
+  p->stop();
+  delete p;
+}
+
+void atpu_dp_route_set(void* dp, const char* agent_id, const char* host, int port,
+                       const char* status, int persist) {
+  static_cast<atpu::DataPlane*>(dp)->route_set(agent_id, host, port, status,
+                                               persist != 0);
+}
+
+void atpu_dp_route_del(void* dp, const char* agent_id) {
+  static_cast<atpu::DataPlane*>(dp)->route_del(agent_id);
+}
+
+// Drain per-agent request counters: fills requests, latency_sum, latency_max.
+void atpu_dp_counters_drain(void* dp, const char* agent_id, uint64_t* requests,
+                            double* latency_sum, double* latency_max) {
+  static_cast<atpu::DataPlane*>(dp)->counters_drain(agent_id, requests, latency_sum,
+                                                    latency_max);
+}
+
+}  // extern "C"

@@ -1,0 +1,203 @@
+// Shared helpers for the native layer: wire encoding, glob matching,
+// base64, JSON string escaping, monotonic/epoch clocks.
+//
+// The wire format is the single command encoding used by (1) the in-process
+// ctypes API, (2) the engine UDS store protocol, and (3) the data plane's
+// internal journal calls — one dispatcher serves all three.
+//
+//   request:  [u8 opcode][u32 argc]([u32 len][bytes])*
+//   response: [u8 status: 0 ok, 1 err, 2 nil][u32 count]([u32 len][bytes])*
+//
+// Integers/doubles travel as ASCII strings; values are binary-safe.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace atpu {
+
+// ---- wire encoding ---------------------------------------------------------
+
+inline void put_u32(std::string& out, uint32_t v) {
+  char b[4];
+  std::memcpy(b, &v, 4);  // little-endian hosts only (x86/ARM TPU-VMs)
+  out.append(b, 4);
+}
+
+inline uint32_t get_u32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+inline void put_arg(std::string& out, const std::string& s) {
+  put_u32(out, static_cast<uint32_t>(s.size()));
+  out.append(s);
+}
+
+struct Request {
+  uint8_t op = 0;
+  std::vector<std::string> args;
+};
+
+// Parse a request buffer; returns false on malformed input.
+inline bool parse_request(const uint8_t* buf, size_t len, Request* out) {
+  if (len < 5) return false;
+  out->op = buf[0];
+  uint32_t argc = get_u32(buf + 1);
+  size_t pos = 5;
+  out->args.clear();
+  out->args.reserve(argc);
+  for (uint32_t i = 0; i < argc; i++) {
+    if (pos + 4 > len) return false;
+    uint32_t alen = get_u32(buf + pos);
+    pos += 4;
+    if (pos + alen > len) return false;
+    out->args.emplace_back(reinterpret_cast<const char*>(buf + pos), alen);
+    pos += alen;
+  }
+  return pos == len;
+}
+
+enum RespStatus : uint8_t { RESP_OK = 0, RESP_ERR = 1, RESP_NIL = 2 };
+
+// Opcodes — mirrored in agentainer_tpu/store/native.py (OP_*) and the engine
+// store client. Keep numbering stable; it is the UDS wire protocol.
+enum Op : uint8_t {
+  OP_SET = 1,     // key value ttl("" = none, seconds otherwise)
+  OP_GET = 2,     // key -> nil | [value]
+  OP_DEL = 3,     // key... -> [n]
+  OP_EXISTS = 4,  // key -> [0|1]
+  OP_KEYS = 5,    // pattern -> [key...]
+  OP_EXPIRE = 6,  // key ttl -> [0|1]
+  OP_TTL = 7,     // key -> nil | [seconds]
+  OP_SADD = 8,    // key member... -> [added]
+  OP_SREM = 9,    // key member... -> [removed]
+  OP_SMEMBERS = 10,
+  OP_RPUSH = 11,  // key value... -> [len]
+  OP_LPUSH = 12,
+  OP_LREM = 13,   // key count value -> [removed]
+  OP_LRANGE = 14, // key start stop -> [value...]
+  OP_LLEN = 15,
+  OP_LTRIM = 16,  // key start stop
+  OP_ZADD = 17,   // key score member
+  OP_ZRANGEBYSCORE = 18,  // key min max limit("" = none) -> [member...]
+  OP_ZREMRANGEBYSCORE = 19,
+  OP_ZCARD = 20,
+  OP_HSET = 21,     // key field value
+  OP_HINCRBY = 22,  // key field amount -> [n]
+  OP_HGETALL = 23,  // key -> [f1 v1 f2 v2 ...]
+  OP_PUBLISH = 24,  // channel message -> [receivers]
+  OP_FLUSH = 25,
+  OP_PIPELINE = 26,  // args are length-prefixed encoded sub-requests;
+                     // response args are encoded sub-responses
+  OP_AUTH = 27,      // agent_id token (UDS only)
+  OP_SETEXAT = 28,   // key value expire_at_epoch("" = none) — AOF replay form
+  OP_EXPIREAT = 29,  // key expire_at_epoch — AOF replay form of EXPIRE
+};
+
+inline std::string make_response(RespStatus st, const std::vector<std::string>& vals) {
+  std::string out;
+  out.push_back(static_cast<char>(st));
+  put_u32(out, static_cast<uint32_t>(vals.size()));
+  for (const auto& v : vals) put_arg(out, v);
+  return out;
+}
+
+inline std::string resp_ok() { return make_response(RESP_OK, {}); }
+inline std::string resp_ok1(const std::string& v) { return make_response(RESP_OK, {v}); }
+inline std::string resp_nil() { return make_response(RESP_NIL, {}); }
+inline std::string resp_err(const std::string& msg) { return make_response(RESP_ERR, {msg}); }
+inline std::string resp_int(long long v) { return resp_ok1(std::to_string(v)); }
+
+// ---- glob matching (fnmatch-style: * ? and literal) ------------------------
+
+inline bool glob_match(const char* pat, const char* str) {
+  // iterative star backtracking
+  const char* star = nullptr;
+  const char* ss = nullptr;
+  while (*str) {
+    if (*pat == '?' || *pat == *str) {
+      pat++;
+      str++;
+    } else if (*pat == '*') {
+      star = pat++;
+      ss = str;
+    } else if (star) {
+      pat = star + 1;
+      str = ++ss;
+    } else {
+      return false;
+    }
+  }
+  while (*pat == '*') pat++;
+  return *pat == '\0';
+}
+
+inline bool glob_match(const std::string& pat, const std::string& str) {
+  return glob_match(pat.c_str(), str.c_str());
+}
+
+// ---- base64 ----------------------------------------------------------------
+
+inline std::string b64_encode(const std::string& in) {
+  static const char tbl[] = "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+  std::string out;
+  out.reserve(((in.size() + 2) / 3) * 4);
+  size_t i = 0;
+  while (i + 2 < in.size()) {
+    uint32_t n = (uint8_t)in[i] << 16 | (uint8_t)in[i + 1] << 8 | (uint8_t)in[i + 2];
+    out.push_back(tbl[(n >> 18) & 63]);
+    out.push_back(tbl[(n >> 12) & 63]);
+    out.push_back(tbl[(n >> 6) & 63]);
+    out.push_back(tbl[n & 63]);
+    i += 3;
+  }
+  if (i + 1 == in.size()) {
+    uint32_t n = (uint8_t)in[i] << 16;
+    out.push_back(tbl[(n >> 18) & 63]);
+    out.push_back(tbl[(n >> 12) & 63]);
+    out.append("==");
+  } else if (i + 2 == in.size()) {
+    uint32_t n = (uint8_t)in[i] << 16 | (uint8_t)in[i + 1] << 8;
+    out.push_back(tbl[(n >> 18) & 63]);
+    out.push_back(tbl[(n >> 12) & 63]);
+    out.push_back(tbl[(n >> 6) & 63]);
+    out.push_back('=');
+  }
+  return out;
+}
+
+// ---- JSON string escaping (for journal records the Python side json.loads) -
+
+inline void json_escape_to(std::string& out, const std::string& s) {
+  out.push_back('"');
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out.append("\\\""); break;
+      case '\\': out.append("\\\\"); break;
+      case '\n': out.append("\\n"); break;
+      case '\r': out.append("\\r"); break;
+      case '\t': out.append("\\t"); break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out.append(buf);
+        } else {
+          out.push_back(static_cast<char>(c));
+        }
+    }
+  }
+  out.push_back('"');
+}
+
+inline std::string json_escape(const std::string& s) {
+  std::string out;
+  json_escape_to(out, s);
+  return out;
+}
+
+}  // namespace atpu

@@ -1,0 +1,99 @@
+// Native data plane — the hot request path of the control plane, in C++.
+//
+// In the reference, every proxied agent request flows through the Go server's
+// proxy handler + Redis journal (internal/api/server.go:493-615,
+// internal/requests/requests.go:64-275). Here that hot path runs on native
+// threads with zero Python involvement:
+//
+//   client ──HTTP──▶ DataPlane ──journal──▶ Store (C++, in-process)
+//                        │
+//                        ├─ /agent/{id}/** : journal → forward to engine →
+//                        │                   settle (completed/pending/failed)
+//                        ├─ /internal/store via UDS: engine state ops,
+//                        │                   token-authed, namespaced
+//                        └─ everything else: forwarded to the Python
+//                                            management server (aiohttp)
+//
+// The Python side owns policy (lifecycle, scheduling, replay, health) and
+// updates the routing table; the C++ side owns per-request mechanics.
+// Outcome classification parity: success → archive response; connection
+// refused / engine vanished → journal entry stays pending for the replay
+// worker (crash heuristic, server.go:597-606); timeout/protocol error →
+// retry accounting toward dead-letter.
+#pragma once
+
+#include <atomic>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <unordered_map>
+
+#include "store.h"
+
+namespace atpu {
+
+class DataPlane {
+ public:
+  DataPlane(Store* store, const std::string& listen_host, int listen_port,
+            const std::string& backend_host, int backend_port,
+            const std::string& uds_path);
+  ~DataPlane();
+
+  bool start();
+  void stop();
+  int port() const { return port_; }
+
+  void route_set(const std::string& agent_id, const std::string& host, int port,
+                 const std::string& status, bool persist);
+  void route_del(const std::string& agent_id);
+
+  void counters_drain(const std::string& agent_id, uint64_t* requests,
+                      double* latency_sum, double* latency_max);
+
+ private:
+  struct Route {
+    std::string host;
+    int port = 0;
+    std::string status;
+    bool persist = true;
+  };
+  struct Counter {
+    uint64_t requests = 0;
+    double lat_sum = 0;
+    double lat_max = 0;
+  };
+
+  void accept_loop(int fd, bool uds);
+  void handle_conn(int fd);
+  void handle_uds_conn(int fd);
+  void track(int fd, bool add);
+
+  Store* store_;
+  std::string listen_host_;
+  int listen_port_;
+  int port_ = 0;
+  std::string backend_host_;
+  int backend_port_;
+  std::string uds_path_;
+
+  int listen_fd_ = -1;
+  int uds_fd_ = -1;
+  std::atomic<bool> stopping_{false};
+  std::atomic<int> active_conns_{0};
+  std::thread accept_thread_;
+  std::thread uds_thread_;
+
+  std::mutex route_mu_;
+  std::unordered_map<std::string, Route> routes_;
+
+  std::mutex counter_mu_;
+  std::unordered_map<std::string, Counter> counters_;
+
+  std::mutex conn_mu_;
+  std::set<int> conns_;
+
+  friend struct ConnCtx;
+};
+
+}  // namespace atpu

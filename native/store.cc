@@ -1,0 +1,530 @@
+// Native store implementation — see store.h for the role and semantics spec.
+#include "store.h"
+
+#include <algorithm>
+#include <cerrno>
+#include <chrono>
+#include <cstdlib>
+
+namespace atpu {
+
+static double now_s() {
+  return std::chrono::duration<double>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+static const char* WRONGTYPE = "WRONGTYPE key holds another value type";
+
+Store::Store(const std::string& aof_path) {
+  if (!aof_path.empty()) {
+    aof_load(aof_path);
+    aof_ = std::fopen(aof_path.c_str(), "ab");
+  }
+}
+
+Store::~Store() {
+  if (aof_) {
+    std::fflush(aof_);
+    std::fclose(aof_);
+  }
+}
+
+bool Store::live_locked(const std::string& key) {
+  auto it = data_.find(key);
+  if (it == data_.end()) return false;
+  if (it->second.expire_at >= 0 && now_s() >= it->second.expire_at) {
+    data_.erase(it);
+    return false;
+  }
+  return true;
+}
+
+Value* Store::typed_locked(const std::string& key, Value::Type t, bool create,
+                           std::string* err) {
+  if (live_locked(key)) {
+    Value& v = data_[key];
+    if (v.type != t) {
+      *err = WRONGTYPE;
+      return nullptr;
+    }
+    return &v;
+  }
+  if (create) {
+    Value& v = data_[key];
+    v = Value();
+    v.type = t;
+    return &v;
+  }
+  return nullptr;
+}
+
+// Normalize Redis-style negative indices for LRANGE/LTRIM (inclusive stop).
+static void norm_range(long long n, long long* start, long long* stop) {
+  if (*start < 0) *start = std::max(0LL, n + *start);
+  if (*stop < 0) *stop = n + *stop;
+  if (*stop >= n) *stop = n - 1;
+}
+
+std::string Store::execute(const Request& req, const std::string& ns) {
+  // Namespace + allowlist enforcement for engine (UDS) callers.
+  if (!ns.empty()) {
+    static const std::set<uint8_t> allowed = {
+        OP_SET, OP_GET, OP_DEL, OP_EXISTS, OP_KEYS, OP_EXPIRE, OP_TTL,
+        OP_RPUSH, OP_LPUSH, OP_LREM, OP_LRANGE, OP_LLEN, OP_LTRIM,
+        OP_HSET, OP_HINCRBY, OP_HGETALL, OP_PIPELINE};
+    if (!allowed.count(req.op)) return resp_err("op not allowed for engines");
+    if (req.op == OP_PIPELINE) {
+      std::vector<std::string> outs;
+      for (const auto& sub_raw : req.args) {
+        Request sub;
+        if (!parse_request(reinterpret_cast<const uint8_t*>(sub_raw.data()),
+                           sub_raw.size(), &sub))
+          return resp_err("malformed pipeline entry");
+        if (sub.op == OP_PIPELINE) return resp_err("nested pipeline");
+      }
+      // validate-all-then-execute so a rejected batch never partially applies
+      for (const auto& sub_raw : req.args) {
+        Request sub;
+        parse_request(reinterpret_cast<const uint8_t*>(sub_raw.data()),
+                      sub_raw.size(), &sub);
+        std::string r = execute(sub, ns);  // recursion depth 1 (nested rejected)
+        outs.push_back(std::move(r));
+      }
+      return make_response(RESP_OK, outs);
+    }
+    if (req.args.empty()) return resp_err("key outside agent namespace");
+    // every key arg must be namespaced: DEL takes keys in all positions,
+    // everything else keys only in arg0 (remaining args are values/indices)
+    size_t key_args = (req.op == OP_DEL) ? req.args.size() : 1;
+    for (size_t i = 0; i < key_args; i++)
+      if (req.args[i].rfind(ns, 0) != 0)
+        return resp_err("key outside agent namespace");
+  }
+
+  if (req.op == OP_PIPELINE) {
+    std::vector<std::string> outs;
+    for (const auto& sub_raw : req.args) {
+      Request sub;
+      if (!parse_request(reinterpret_cast<const uint8_t*>(sub_raw.data()),
+                         sub_raw.size(), &sub))
+        return resp_err("malformed pipeline entry");
+      if (sub.op == OP_PIPELINE) return resp_err("nested pipeline");
+      outs.push_back(execute(sub));
+    }
+    return make_response(RESP_OK, outs);
+  }
+  if (req.op == OP_PUBLISH) {
+    if (req.args.size() != 2) return resp_err("PUBLISH needs channel message");
+    return resp_int(publish(req.args[0], req.args[1]));
+  }
+
+  std::string aof_rec;
+  std::string resp;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    resp = execute_locked(req, aof_ ? &aof_rec : nullptr);
+    // append while holding mu_ so the AOF order matches apply order —
+    // otherwise concurrent writers could log mutations out of order and
+    // replay would reconstruct a state the live store never had
+    if (!aof_rec.empty() && resp.size() && resp[0] == RESP_OK) aof_append(aof_rec);
+  }
+  return resp;
+}
+
+// Serialize a mutating request into an AOF record. SET rewrites to SETEXAT
+// (absolute deadline) so replay after restart honors the original expiry.
+static std::string aof_record(uint8_t op, const std::vector<std::string>& args) {
+  std::string rec;
+  rec.push_back(static_cast<char>(op));
+  put_u32(rec, static_cast<uint32_t>(args.size()));
+  for (const auto& a : args) put_arg(rec, a);
+  std::string framed;
+  put_u32(framed, static_cast<uint32_t>(rec.size()));
+  framed += rec;
+  return framed;
+}
+
+std::string Store::execute_locked(const Request& req, std::string* aof_out) {
+  const auto& a = req.args;
+  std::string err;
+  auto wrongtype = [&]() { return resp_err(err); };
+
+  switch (req.op) {
+    case OP_SET:
+    case OP_SETEXAT: {
+      if (a.size() != 3) return resp_err("SET needs key value ttl");
+      double expire_at = -1.0;
+      if (!a[2].empty()) {
+        double v = std::strtod(a[2].c_str(), nullptr);
+        expire_at = (req.op == OP_SETEXAT) ? v : now_s() + v;
+      }
+      Value& val = data_[a[0]];
+      val = Value();
+      val.type = Value::STR;
+      val.str = a[1];
+      val.expire_at = expire_at;
+      if (aof_out)
+        *aof_out = aof_record(OP_SETEXAT,
+                              {a[0], a[1], expire_at < 0 ? "" : std::to_string(expire_at)});
+      return resp_ok();
+    }
+    case OP_GET: {
+      if (a.size() != 1) return resp_err("GET needs key");
+      if (!live_locked(a[0])) return resp_nil();
+      Value& v = data_[a[0]];
+      if (v.type != Value::STR) return resp_err(WRONGTYPE);
+      return resp_ok1(v.str);
+    }
+    case OP_DEL: {
+      long long n = 0;
+      for (const auto& key : a) {
+        if (live_locked(key)) n++;
+        data_.erase(key);
+      }
+      if (aof_out && !a.empty()) *aof_out = aof_record(OP_DEL, a);
+      return resp_int(n);
+    }
+    case OP_EXISTS: {
+      if (a.size() != 1) return resp_err("EXISTS needs key");
+      return resp_int(live_locked(a[0]) ? 1 : 0);
+    }
+    case OP_KEYS: {
+      if (a.size() != 1) return resp_err("KEYS needs pattern");
+      std::vector<std::string> out;
+      std::vector<std::string> doomed;
+      for (auto& kv : data_) {
+        if (kv.second.expire_at >= 0 && now_s() >= kv.second.expire_at) {
+          doomed.push_back(kv.first);
+          continue;
+        }
+        if (glob_match(a[0], kv.first)) out.push_back(kv.first);
+      }
+      for (const auto& k : doomed) data_.erase(k);
+      std::sort(out.begin(), out.end());
+      return make_response(RESP_OK, out);
+    }
+    case OP_EXPIRE:
+    case OP_EXPIREAT: {
+      if (a.size() != 2) return resp_err("EXPIRE needs key ttl");
+      if (!live_locked(a[0])) return resp_int(0);
+      double arg = std::strtod(a[1].c_str(), nullptr);
+      double deadline = (req.op == OP_EXPIREAT) ? arg : now_s() + arg;
+      data_[a[0]].expire_at = deadline;
+      // logged with the absolute deadline so replay honors the original expiry
+      if (aof_out) *aof_out = aof_record(OP_EXPIREAT, {a[0], std::to_string(deadline)});
+      return resp_int(1);
+    }
+    case OP_TTL: {
+      if (a.size() != 1) return resp_err("TTL needs key");
+      if (!live_locked(a[0])) return resp_nil();
+      double exp = data_[a[0]].expire_at;
+      if (exp < 0) return resp_nil();
+      double rem = exp - now_s();
+      return resp_ok1(std::to_string(rem < 0 ? 0.0 : rem));
+    }
+    case OP_SADD: {
+      if (a.size() < 2) return resp_err("SADD needs key member...");
+      Value* v = typed_locked(a[0], Value::SET, true, &err);
+      if (!v) return wrongtype();
+      size_t before = v->sset.size();
+      for (size_t i = 1; i < a.size(); i++) v->sset.insert(a[i]);
+      if (aof_out) *aof_out = aof_record(OP_SADD, a);
+      return resp_int(static_cast<long long>(v->sset.size() - before));
+    }
+    case OP_SREM: {
+      if (a.size() < 2) return resp_err("SREM needs key member...");
+      Value* v = typed_locked(a[0], Value::SET, false, &err);
+      if (!err.empty()) return wrongtype();
+      if (!v) return resp_int(0);
+      long long n = 0;
+      for (size_t i = 1; i < a.size(); i++) n += v->sset.erase(a[i]);
+      if (v->sset.empty()) data_.erase(a[0]);
+      if (aof_out) *aof_out = aof_record(OP_SREM, a);
+      return resp_int(n);
+    }
+    case OP_SMEMBERS: {
+      if (a.size() != 1) return resp_err("SMEMBERS needs key");
+      Value* v = typed_locked(a[0], Value::SET, false, &err);
+      if (!err.empty()) return wrongtype();
+      if (!v) return make_response(RESP_OK, {});
+      return make_response(RESP_OK,
+                           std::vector<std::string>(v->sset.begin(), v->sset.end()));
+    }
+    case OP_RPUSH:
+    case OP_LPUSH: {
+      if (a.size() < 2) return resp_err("PUSH needs key value...");
+      Value* v = typed_locked(a[0], Value::LIST, true, &err);
+      if (!v) return wrongtype();
+      for (size_t i = 1; i < a.size(); i++) {
+        if (req.op == OP_RPUSH)
+          v->list.push_back(a[i]);
+        else
+          v->list.push_front(a[i]);
+      }
+      if (aof_out) *aof_out = aof_record(req.op, a);
+      return resp_int(static_cast<long long>(v->list.size()));
+    }
+    case OP_LREM: {
+      if (a.size() != 3) return resp_err("LREM needs key count value");
+      Value* v = typed_locked(a[0], Value::LIST, false, &err);
+      if (!err.empty()) return wrongtype();
+      if (!v) return resp_int(0);
+      long long count = std::strtoll(a[1].c_str(), nullptr, 10);
+      const std::string& target = a[2];
+      long long removed = 0;
+      std::deque<std::string> out;
+      if (count >= 0) {
+        long long limit = count > 0 ? count : static_cast<long long>(v->list.size());
+        for (auto& item : v->list) {
+          if (item == target && removed < limit)
+            removed++;
+          else
+            out.push_back(std::move(item));
+        }
+      } else {
+        long long limit = -count;
+        for (auto it = v->list.rbegin(); it != v->list.rend(); ++it) {
+          if (*it == target && removed < limit)
+            removed++;
+          else
+            out.push_front(std::move(*it));
+        }
+      }
+      v->list = std::move(out);
+      if (v->list.empty()) data_.erase(a[0]);
+      if (aof_out) *aof_out = aof_record(OP_LREM, a);
+      return resp_int(removed);
+    }
+    case OP_LRANGE: {
+      if (a.size() != 3) return resp_err("LRANGE needs key start stop");
+      Value* v = typed_locked(a[0], Value::LIST, false, &err);
+      if (!err.empty()) return wrongtype();
+      if (!v) return make_response(RESP_OK, {});
+      long long n = static_cast<long long>(v->list.size());
+      long long start = std::strtoll(a[1].c_str(), nullptr, 10);
+      long long stop = std::strtoll(a[2].c_str(), nullptr, 10);
+      norm_range(n, &start, &stop);
+      std::vector<std::string> out;
+      for (long long i = start; i <= stop && i < n; i++)
+        if (i >= 0) out.push_back(v->list[i]);
+      return make_response(RESP_OK, out);
+    }
+    case OP_LLEN: {
+      if (a.size() != 1) return resp_err("LLEN needs key");
+      Value* v = typed_locked(a[0], Value::LIST, false, &err);
+      if (!err.empty()) return wrongtype();
+      return resp_int(v ? static_cast<long long>(v->list.size()) : 0);
+    }
+    case OP_LTRIM: {
+      if (a.size() != 3) return resp_err("LTRIM needs key start stop");
+      Value* v = typed_locked(a[0], Value::LIST, false, &err);
+      if (!err.empty()) return wrongtype();
+      if (!v) return resp_ok();
+      long long n = static_cast<long long>(v->list.size());
+      long long start = std::strtoll(a[1].c_str(), nullptr, 10);
+      long long stop = std::strtoll(a[2].c_str(), nullptr, 10);
+      norm_range(n, &start, &stop);
+      std::deque<std::string> kept;
+      for (long long i = start; i <= stop && i < n; i++)
+        if (i >= 0) kept.push_back(std::move(v->list[i]));
+      if (kept.empty())
+        data_.erase(a[0]);
+      else
+        v->list = std::move(kept);
+      if (aof_out) *aof_out = aof_record(OP_LTRIM, a);
+      return resp_ok();
+    }
+    case OP_ZADD: {
+      if (a.size() != 3) return resp_err("ZADD needs key score member");
+      Value* v = typed_locked(a[0], Value::ZSET, true, &err);
+      if (!v) return wrongtype();
+      v->zscores[a[2]] = std::strtod(a[1].c_str(), nullptr);
+      if (aof_out) *aof_out = aof_record(OP_ZADD, a);
+      return resp_ok();
+    }
+    case OP_ZRANGEBYSCORE: {
+      if (a.size() != 4) return resp_err("ZRANGEBYSCORE needs key min max limit");
+      Value* v = typed_locked(a[0], Value::ZSET, false, &err);
+      if (!err.empty()) return wrongtype();
+      if (!v) return make_response(RESP_OK, {});
+      double lo = std::strtod(a[1].c_str(), nullptr);
+      double hi = std::strtod(a[2].c_str(), nullptr);
+      long long limit = a[3].empty() ? -1 : std::strtoll(a[3].c_str(), nullptr, 10);
+      std::vector<std::pair<double, std::string>> hits;
+      for (const auto& kv : v->zscores)
+        if (kv.second >= lo && kv.second <= hi) hits.push_back({kv.second, kv.first});
+      std::sort(hits.begin(), hits.end());
+      std::vector<std::string> out;
+      for (const auto& h : hits) {
+        if (limit >= 0 && static_cast<long long>(out.size()) >= limit) break;
+        out.push_back(h.second);
+      }
+      return make_response(RESP_OK, out);
+    }
+    case OP_ZREMRANGEBYSCORE: {
+      if (a.size() != 3) return resp_err("ZREMRANGEBYSCORE needs key min max");
+      Value* v = typed_locked(a[0], Value::ZSET, false, &err);
+      if (!err.empty()) return wrongtype();
+      if (!v) return resp_int(0);
+      double lo = std::strtod(a[1].c_str(), nullptr);
+      double hi = std::strtod(a[2].c_str(), nullptr);
+      long long n = 0;
+      for (auto it = v->zscores.begin(); it != v->zscores.end();) {
+        if (it->second >= lo && it->second <= hi) {
+          it = v->zscores.erase(it);
+          n++;
+        } else {
+          ++it;
+        }
+      }
+      if (v->zscores.empty()) data_.erase(a[0]);
+      if (aof_out) *aof_out = aof_record(OP_ZREMRANGEBYSCORE, a);
+      return resp_int(n);
+    }
+    case OP_ZCARD: {
+      if (a.size() != 1) return resp_err("ZCARD needs key");
+      Value* v = typed_locked(a[0], Value::ZSET, false, &err);
+      if (!err.empty()) return wrongtype();
+      return resp_int(v ? static_cast<long long>(v->zscores.size()) : 0);
+    }
+    case OP_HSET: {
+      if (a.size() != 3) return resp_err("HSET needs key field value");
+      Value* v = typed_locked(a[0], Value::HASH, true, &err);
+      if (!v) return wrongtype();
+      v->hash[a[1]] = a[2];
+      if (aof_out) *aof_out = aof_record(OP_HSET, a);
+      return resp_ok();
+    }
+    case OP_HINCRBY: {
+      if (a.size() != 3) return resp_err("HINCRBY needs key field amount");
+      Value* v = typed_locked(a[0], Value::HASH, true, &err);
+      if (!v) return wrongtype();
+      long long cur = 0;
+      auto it = v->hash.find(a[1]);
+      if (it != v->hash.end()) cur = std::strtoll(it->second.c_str(), nullptr, 10);
+      cur += std::strtoll(a[2].c_str(), nullptr, 10);
+      v->hash[a[1]] = std::to_string(cur);
+      if (aof_out) *aof_out = aof_record(OP_HSET, {a[0], a[1], v->hash[a[1]]});
+      return resp_int(cur);
+    }
+    case OP_HGETALL: {
+      if (a.size() != 1) return resp_err("HGETALL needs key");
+      Value* v = typed_locked(a[0], Value::HASH, false, &err);
+      if (!err.empty()) return wrongtype();
+      std::vector<std::string> out;
+      if (v)
+        for (const auto& kv : v->hash) {
+          out.push_back(kv.first);
+          out.push_back(kv.second);
+        }
+      return make_response(RESP_OK, out);
+    }
+    case OP_FLUSH: {
+      data_.clear();
+      if (aof_out) *aof_out = aof_record(OP_FLUSH, {});
+      return resp_ok();
+    }
+    default:
+      return resp_err("unknown op " + std::to_string(req.op));
+  }
+}
+
+// ---- pub/sub ---------------------------------------------------------------
+
+int Store::publish(const std::string& channel, const std::string& message) {
+  int n = 0;
+  {
+    std::lock_guard<std::mutex> lk(sub_mu_);
+    for (auto& kv : subs_) {
+      auto& sub = *kv.second;
+      if (sub.closed) continue;
+      for (const auto& pat : sub.patterns) {
+        if (glob_match(pat, channel)) {
+          sub.queue.push_back({channel, message});
+          n++;
+          break;
+        }
+      }
+    }
+  }
+  if (n) sub_cv_.notify_all();
+  return n;
+}
+
+uint64_t Store::subscribe(const std::vector<std::string>& patterns) {
+  std::lock_guard<std::mutex> lk(sub_mu_);
+  uint64_t id = next_sub_id_++;
+  auto sub = std::make_shared<Subscription>();
+  sub->patterns = patterns;
+  subs_[id] = sub;
+  return id;
+}
+
+int Store::sub_poll(uint64_t sub_id, int timeout_ms, std::string* channel,
+                    std::string* message) {
+  std::unique_lock<std::mutex> lk(sub_mu_);
+  auto it = subs_.find(sub_id);
+  if (it == subs_.end() || it->second->closed) return -1;
+  auto sub = it->second;
+  if (sub->queue.empty() && timeout_ms > 0) {
+    sub_cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
+      return sub->closed || !sub->queue.empty();
+    });
+  }
+  if (sub->closed) return -1;
+  if (sub->queue.empty()) return 0;
+  *channel = std::move(sub->queue.front().first);
+  *message = std::move(sub->queue.front().second);
+  sub->queue.pop_front();
+  return 1;
+}
+
+void Store::sub_close(uint64_t sub_id) {
+  {
+    std::lock_guard<std::mutex> lk(sub_mu_);
+    auto it = subs_.find(sub_id);
+    if (it == subs_.end()) return;
+    it->second->closed = true;
+    subs_.erase(it);
+  }
+  sub_cv_.notify_all();
+}
+
+// ---- AOF -------------------------------------------------------------------
+
+void Store::aof_append(const std::string& rec) {
+  std::lock_guard<std::mutex> lk(aof_mu_);
+  if (!aof_) return;
+  std::fwrite(rec.data(), 1, rec.size(), aof_);
+}
+
+void Store::aof_flush() {
+  std::lock_guard<std::mutex> lk(aof_mu_);
+  if (aof_) std::fflush(aof_);
+}
+
+void Store::aof_load(const std::string& path) {
+  std::FILE* f = std::fopen(path.c_str(), "rb");
+  if (!f) return;
+  std::string buf;
+  char chunk[1 << 16];
+  size_t n;
+  while ((n = std::fread(chunk, 1, sizeof(chunk), f)) > 0) buf.append(chunk, n);
+  std::fclose(f);
+  size_t pos = 0;
+  while (pos + 4 <= buf.size()) {
+    uint32_t rec_len = get_u32(reinterpret_cast<const uint8_t*>(buf.data() + pos));
+    pos += 4;
+    if (pos + rec_len > buf.size()) break;  // truncated tail record: stop
+    Request req;
+    if (parse_request(reinterpret_cast<const uint8_t*>(buf.data() + pos), rec_len,
+                      &req)) {
+      std::lock_guard<std::mutex> lk(mu_);
+      execute_locked(req, nullptr);
+    }
+    pos += rec_len;
+  }
+}
+
+}  // namespace atpu

@@ -1,0 +1,82 @@
+// Native control-plane state store — the Redis role in the reference
+// (internal/storage/storage.go + the key schema in SURVEY.md §2.2),
+// implemented in C++ so the data plane journals requests without touching
+// the Python interpreter, and so state survives daemon restarts via an AOF
+// (the durability Redis gave the reference's Go server).
+//
+// Semantics mirror agentainer_tpu/store/memory.py (the behavioral spec both
+// implementations are tested against): lazy TTL expiry, counted LREM,
+// inclusive LRANGE/LTRIM stops, (score, member)-ordered ZRANGEBYSCORE,
+// glob-pattern pub/sub.
+#pragma once
+
+#include <condition_variable>
+#include <cstdio>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+
+namespace atpu {
+
+struct Value {
+  enum Type { STR, LIST, SET, ZSET, HASH } type = STR;
+  std::string str;
+  std::deque<std::string> list;
+  std::set<std::string> sset;
+  std::map<std::string, double> zscores;          // member -> score
+  std::map<std::string, std::string> hash;        // field -> value
+  double expire_at = -1.0;                        // epoch seconds; -1 = none
+};
+
+struct Subscription {
+  std::vector<std::string> patterns;
+  std::deque<std::pair<std::string, std::string>> queue;  // (channel, message)
+  bool closed = false;
+};
+
+class Store {
+ public:
+  explicit Store(const std::string& aof_path = "");
+  ~Store();
+
+  // Execute one encoded command (see common.h wire format). When `ns` is
+  // non-empty, key/pattern args must start with it (engine UDS namespacing)
+  // and ops outside the engine allowlist are rejected.
+  std::string execute(const Request& req, const std::string& ns = "");
+
+  // Pub/sub used in-process.
+  int publish(const std::string& channel, const std::string& message);
+  uint64_t subscribe(const std::vector<std::string>& patterns);
+  // Returns 1 and fills channel/message, or 0 on timeout, -1 if closed/unknown.
+  int sub_poll(uint64_t sub_id, int timeout_ms, std::string* channel, std::string* message);
+  void sub_close(uint64_t sub_id);
+
+  void aof_flush();
+
+ private:
+  bool live_locked(const std::string& key);  // expiry check; may erase
+  Value* typed_locked(const std::string& key, Value::Type t, bool create, std::string* err);
+  std::string execute_locked(const Request& req, std::string* aof_out);
+  void aof_append(const std::string& rec);
+  void aof_load(const std::string& path);
+
+  std::mutex mu_;
+  std::unordered_map<std::string, Value> data_;
+
+  std::mutex sub_mu_;
+  std::condition_variable sub_cv_;
+  std::unordered_map<uint64_t, std::shared_ptr<Subscription>> subs_;
+  uint64_t next_sub_id_ = 1;
+
+  std::mutex aof_mu_;
+  std::FILE* aof_ = nullptr;
+};
+
+}  // namespace atpu

@@ -29,11 +29,29 @@ import socket  # noqa: E402
 import pytest  # noqa: E402
 
 
-@pytest.fixture
-def store():
-    from agentainer_tpu.store import MemoryStore
+def _native_available() -> bool:
+    try:
+        from agentainer_tpu.native import available
 
-    s = MemoryStore()
+        return available()
+    except Exception:
+        return False
+
+
+@pytest.fixture(params=["memory", "native"])
+def store(request):
+    """Every store-semantics test runs against both implementations — the
+    MemoryStore is the behavioral spec the C++ store must match."""
+    if request.param == "native":
+        if not _native_available():
+            pytest.skip("native library unavailable")
+        from agentainer_tpu.store.native import NativeStore
+
+        s = NativeStore()
+    else:
+        from agentainer_tpu.store import MemoryStore
+
+        s = MemoryStore()
     yield s
     s.close()
 

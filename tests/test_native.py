@@ -1,0 +1,100 @@
+"""Native-layer tests: AOF durability and the data-plane proxy.
+
+The reference's durability story is "state lives in Redis, the server can
+restart" (SURVEY.md §5.4 tier a). The native store's AOF is that tier for
+this framework: every mutation is logged and replayed on reopen, so agent
+records/journals survive a daemon restart.
+"""
+
+import json
+import time
+
+import pytest
+
+from tests.conftest import _native_available
+
+pytestmark = pytest.mark.skipif(
+    not _native_available(), reason="native library unavailable"
+)
+
+
+@pytest.fixture
+def aof(tmp_path):
+    return str(tmp_path / "store.aof")
+
+
+def reopen(aof):
+    from agentainer_tpu.store.native import NativeStore
+
+    return NativeStore(aof_path=aof)
+
+
+class TestAOF:
+    def test_strings_survive_reopen(self, aof):
+        s = reopen(aof)
+        s.set("agent:a", json.dumps({"id": "a", "status": "running"}))
+        s.sadd("agents:list", "a", "b")
+        s.close()
+
+        s2 = reopen(aof)
+        assert json.loads(s2.get("agent:a")) == {"id": "a", "status": "running"}
+        assert s2.smembers("agents:list") == {"a", "b"}
+        s2.close()
+
+    def test_all_types_survive_reopen(self, aof):
+        s = reopen(aof)
+        s.rpush("l", "x", "y", "z")
+        s.lrem("l", 1, "y")
+        s.zadd("z", 3.0, "m3")
+        s.zadd("z", 1.0, "m1")
+        s.hset("h", "f", "v")
+        s.hincrby("h", "n", 7)
+        s.delete("l2")
+        s.close()
+
+        s2 = reopen(aof)
+        assert s2.lrange("l", 0, -1) == [b"x", b"z"]
+        assert s2.zrangebyscore("z", 0, 10) == [b"m1", b"m3"]
+        assert s2.hgetall("h") == {"f": b"v", "n": b"7"}
+        s2.close()
+
+    def test_ttl_survives_as_absolute_deadline(self, aof):
+        s = reopen(aof)
+        s.set("short", "v", ttl=0.05)
+        s.set("long", "v", ttl=3600)
+        s.close()
+        time.sleep(0.07)
+
+        s2 = reopen(aof)
+        assert s2.get("short") is None  # deadline passed while "down"
+        assert s2.get("long") == b"v"
+        assert 3500 < s2.ttl("long") <= 3600
+        s2.close()
+
+    def test_truncated_tail_record_is_ignored(self, aof):
+        s = reopen(aof)
+        s.set("k", "v")
+        s.close()
+        with open(aof, "ab") as f:
+            f.write(b"\xff\xff\xff\x7f partial garbage")
+
+        s2 = reopen(aof)
+        assert s2.get("k") == b"v"
+        s2.close()
+
+    def test_delete_and_flush_are_logged(self, aof):
+        s = reopen(aof)
+        s.set("k1", "v1")
+        s.set("k2", "v2")
+        s.delete("k1")
+        s.close()
+
+        s2 = reopen(aof)
+        assert s2.get("k1") is None
+        assert s2.get("k2") == b"v2"
+        s2.flush()
+        s2.close()
+
+        s3 = reopen(aof)
+        assert s3.keys("*") == []
+        s3.close()

@@ -113,9 +113,15 @@ def test_pubsub_callback(store):
     got = []
     unreg = store.on_message("agent:status:*", lambda ch, msg: got.append((ch, msg)))
     store.publish("agent:status:a", "running")
+    # delivery may be async (native store polls from a helper thread)
+    deadline = time.time() + 2.0
+    while not got and time.time() < deadline:
+        time.sleep(0.01)
     assert got == [("agent:status:a", "running")]
     unreg()
+    time.sleep(0.05)  # let the poller observe the unregister
     store.publish("agent:status:a", "stopped")
+    time.sleep(0.3)
     assert len(got) == 1
 
 

@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.attention import attention_reference, cache_mask, causal_mask, flash_attention
+from ..ops.attention import attention_reference, cache_attention, causal_mask, flash_attention
 from ..ops.norms import rms_norm
 from ..ops.rope import apply_rope
 from .configs import ModelConfig
@@ -136,9 +136,9 @@ def _attention_block(
         batch_idx = jnp.arange(b)[:, None]
         ck = ck.at[batch_idx, positions].set(k)
         cv = cv.at[batch_idx, positions].set(v)
-        attn = attention_reference(q, ck, cv, mask=mask)
+        attn = cache_attention(q, ck, cv, positions)
     elif use_flash:
-        attn = flash_attention(q, k, v, mask=mask)
+        attn = flash_attention(q, k, v, causal=True)
     else:
         attn = attention_reference(q, k, v, mask=mask)
     out = attn.reshape(b, t, cfg.n_heads * cfg.head_dim) @ lp["wo"]
@@ -161,7 +161,7 @@ def forward(
     """
     x = params["embed"][tokens].astype(params["embed"].dtype)
     if cache is not None:
-        mask = cache_mask(positions, cache.k.shape[2])  # [B, T, S]
+        mask = None  # cache_attention masks from positions (in-kernel on TPU)
     else:
         t = tokens.shape[1]
         mask = jnp.broadcast_to(causal_mask(t), (tokens.shape[0], t, t))

@@ -12,6 +12,8 @@ reference path — CI runs the same code on CPU meshes.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -61,6 +63,16 @@ def cache_mask(q_positions: jnp.ndarray, cache_len: int) -> jnp.ndarray:
     return slots <= q_positions[:, :, None]
 
 
+def _use_pallas(n_heads: int, n_kv_heads: int, head_dim: int) -> bool:
+    if os.environ.get("AGENTAINER_NO_PALLAS"):
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    from .pallas_attention import kernel_supported
+
+    return kernel_supported(n_heads, n_kv_heads, head_dim)
+
+
 def flash_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -70,13 +82,32 @@ def flash_attention(
 ) -> jnp.ndarray:
     """Dispatch: Pallas blockwise kernel on TPU (prefill-shaped inputs),
     XLA reference elsewhere."""
+    if causal and mask is None and _use_pallas(q.shape[2], k.shape[2], q.shape[3]):
+        from .pallas_attention import flash_attention_tpu
+
+        return flash_attention_tpu(q, k, v)
     if causal and mask is None:
         mask = causal_mask(q.shape[1])
-    if jax.default_backend() == "tpu":
-        try:
-            from .pallas_attention import flash_attention_tpu
-
-            return flash_attention_tpu(q, k, v, mask=mask)
-        except Exception:
-            pass  # shapes/platform not supported by the kernel: fall through
     return attention_reference(q, k, v, mask=mask)
+
+
+def cache_attention(
+    q: jnp.ndarray,  # [B, T, H, hd]
+    ck: jnp.ndarray,  # [B, S, KV, hd] arena (slots >= positions are unwritten)
+    cv: jnp.ndarray,  # [B, S, KV, hd]
+    positions: jnp.ndarray,  # [B, T] int32 per-sequence absolute positions
+) -> jnp.ndarray:
+    """Attention over the KV arena: row t sees slot j iff j <= positions[b,t].
+
+    This is the serving hot path (both ragged cached prefill and T==1
+    decode). On TPU it dispatches to the Pallas flash kernels, which build
+    the mask in-register; elsewhere it materializes ``cache_mask`` and runs
+    the XLA reference."""
+    if _use_pallas(q.shape[2], ck.shape[2], q.shape[3]):
+        from .pallas_attention import flash_decode, flash_prefill
+
+        if q.shape[1] == 1:
+            out = flash_decode(q[:, 0], ck, cv, positions[:, 0])
+            return out[:, None]
+        return flash_prefill(q, ck, cv, positions)
+    return attention_reference(q, ck, cv, mask=cache_mask(positions, ck.shape[1]))

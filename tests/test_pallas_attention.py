@@ -1,0 +1,96 @@
+"""Pallas flash-attention kernels vs the XLA reference (interpret mode).
+
+CPU CI runs the exact TPU kernel bodies under ``interpret=True``; the XLA
+``attention_reference`` + ``cache_mask`` pair is the behavioral spec
+(SURVEY.md §4: promote intent to real tests with TPU-less fixtures).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agentainer_tpu.ops.attention import attention_reference, cache_mask, causal_mask
+from agentainer_tpu.ops.pallas_attention import flash_decode, flash_prefill
+
+
+def _rand(key, *shape):
+    return jax.random.normal(key, shape, jnp.float32)
+
+
+@pytest.mark.parametrize("heads,kv_heads", [(4, 2), (2, 2), (8, 1)])
+def test_prefill_causal_matches_reference(heads, kv_heads):
+    b, t, hd = 2, 40, 128
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = _rand(k1, b, t, heads, hd)
+    k = _rand(k2, b, t, kv_heads, hd)
+    v = _rand(k3, b, t, kv_heads, hd)
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+
+    got = flash_prefill(q, k, v, positions, interpret=True)
+    mask = jnp.broadcast_to(causal_mask(t), (b, t, t))
+    want = attention_reference(q, k, v, mask=mask)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_prefill_cached_ragged_positions():
+    """Continuous-batching shape: each sequence prefills at its own offset
+    into a shared arena; arena length not a multiple of the KV block."""
+    b, t, heads, kv_heads, hd, s = 3, 16, 4, 2, 128, 384
+    keys = jax.random.split(jax.random.PRNGKey(1), 4)
+    q = _rand(keys[0], b, t, heads, hd)
+    ck = _rand(keys[1], b, s, kv_heads, hd)
+    cv = _rand(keys[2], b, s, kv_heads, hd)
+    offsets = jnp.array([0, 77, 300], jnp.int32)
+    positions = offsets[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+
+    got = flash_prefill(q, ck, cv, positions, interpret=True)
+    want = attention_reference(q, ck, cv, mask=cache_mask(positions, s))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_prefill_multiple_q_blocks():
+    b, t, heads, kv_heads, hd, s = 1, 320, 4, 4, 128, 320
+    keys = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = _rand(keys[0], b, t, heads, hd)
+    k = _rand(keys[1], b, s, kv_heads, hd)
+    v = _rand(keys[2], b, s, kv_heads, hd)
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+
+    got = flash_prefill(q, k, v, positions, block_q=128, block_k=128, interpret=True)
+    want = attention_reference(q, k, v, mask=cache_mask(positions, s))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("block_k", [128, 512])
+def test_decode_matches_reference(block_k):
+    b, heads, kv_heads, hd, s = 4, 4, 2, 128, 384
+    keys = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = _rand(keys[0], b, heads, hd)
+    ck = _rand(keys[1], b, s, kv_heads, hd)
+    cv = _rand(keys[2], b, s, kv_heads, hd)
+    positions = jnp.array([0, 5, 200, 383], jnp.int32)
+
+    got = flash_decode(q, ck, cv, positions, block_k=block_k, interpret=True)
+    want = attention_reference(
+        q[:, None], ck, cv, mask=cache_mask(positions[:, None], s)
+    )[:, 0]
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_decode_bf16():
+    b, heads, kv_heads, hd, s = 2, 4, 2, 128, 256
+    keys = jax.random.split(jax.random.PRNGKey(4), 3)
+    q = _rand(keys[0], b, heads, hd).astype(jnp.bfloat16)
+    ck = _rand(keys[1], b, s, kv_heads, hd).astype(jnp.bfloat16)
+    cv = _rand(keys[2], b, s, kv_heads, hd).astype(jnp.bfloat16)
+    positions = jnp.array([31, 255], jnp.int32)
+
+    got = flash_decode(q, ck, cv, positions, interpret=True)
+    want = attention_reference(
+        q[:, None], ck, cv, mask=cache_mask(positions[:, None], s)
+    )[:, 0]
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        got.astype(np.float32), want.astype(np.float32), rtol=3e-2, atol=3e-2
+    )

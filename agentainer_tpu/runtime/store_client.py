@@ -24,45 +24,23 @@ from typing import Any
 
 import aiohttp
 
-# opcode mirror of native/common.h (subset engines use)
+# single source of truth for the native wire codec: agentainer_tpu.store.native
+# mirrors native/common.h; importing it has no side effects (CDLL load is lazy)
+from ..store import native as _wire
+
+_enc = _wire.encode_request
+_dec = _wire.decode_response
+
+# op-name → opcode, resolved from the one OP_* table ("delete" is OP_DEL)
 _OP_NUM = {
-    "set": 1,
-    "get": 2,
-    "delete": 3,
-    "keys": 5,
-    "expire": 6,
-    "ttl": 7,
-    "rpush": 11,
-    "lpush": 12,
-    "lrem": 13,
-    "lrange": 14,
-    "llen": 15,
-    "ltrim": 16,
-    "hset": 21,
-    "hincrby": 22,
-    "hgetall": 23,
-    "pipeline": 26,
-    "auth": 27,
+    name: getattr(_wire, f"OP_{name.upper()}")
+    for name in (
+        "set", "get", "keys", "expire", "ttl",
+        "rpush", "lpush", "lrem", "lrange", "llen", "ltrim",
+        "hset", "hincrby", "hgetall", "pipeline", "auth",
+    )
 }
-
-
-def _enc(op: int, args: list[bytes]) -> bytes:
-    out = [struct.pack("<BI", op, len(args))]
-    for a in args:
-        out.append(struct.pack("<I", len(a)) + a)
-    return b"".join(out)
-
-
-def _dec(buf: bytes) -> tuple[int, list[bytes]]:
-    status = buf[0]
-    (count,) = struct.unpack_from("<I", buf, 1)
-    vals, pos = [], 5
-    for _ in range(count):
-        (alen,) = struct.unpack_from("<I", buf, pos)
-        pos += 4
-        vals.append(buf[pos : pos + alen])
-        pos += alen
-    return status, vals
+_OP_NUM["delete"] = _wire.OP_DEL
 
 
 class _UDSPool:

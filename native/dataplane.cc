@@ -11,6 +11,7 @@
 
 #include <algorithm>
 #include <cctype>
+#include <cerrno>
 #include <chrono>
 #include <cstring>
 #include <random>
@@ -132,11 +133,13 @@ static bool send_all(int fd, const std::string& s) {
 }
 
 // Parse one HTTP message from the socket. is_response selects status-line vs
-// request-line. Handles Content-Length bodies and (responses only) chunked
-// transfer coding. `eof_clean` reports EOF-before-first-byte, which on a
-// reused upstream connection means a stale keepalive, not a crash.
+// request-line. Handles Content-Length and chunked bodies. `eof_clean`
+// reports EOF-before-first-byte, which on a reused upstream connection means
+// a stale keepalive, not a crash. `response_to_head`: HEAD responses carry
+// Content-Length but no body (RFC 9110 §6.4.1), so body reads must be skipped.
 static bool read_http(SockBuf& sb, bool is_response, HttpMsg* msg,
-                      bool* eof_clean = nullptr) {
+                      bool* eof_clean = nullptr, bool response_to_head = false) {
+  static const long long MAX_BODY = 1LL << 31;  // shared CL/chunked cap
   if (eof_clean) *eof_clean = false;
   std::string line;
   if (sb.buf.empty() && eof_clean) {
@@ -145,49 +148,68 @@ static bool read_http(SockBuf& sb, bool is_response, HttpMsg* msg,
       return false;
     }
   }
-  if (!sb.read_line(&line)) return false;
-  msg->headers.clear();
-  msg->body.clear();
-  if (is_response) {
-    // HTTP/1.1 200 OK
-    if (line.size() < 12 || line.compare(0, 5, "HTTP/") != 0) return false;
-    msg->status = std::atoi(line.c_str() + 9);
-    msg->version = line.substr(0, 8);
-  } else {
-    size_t sp1 = line.find(' ');
-    size_t sp2 = line.rfind(' ');
-    if (sp1 == std::string::npos || sp2 == sp1) return false;
-    msg->method = line.substr(0, sp1);
-    msg->target = line.substr(sp1 + 1, sp2 - sp1 - 1);
-    msg->version = line.substr(sp2 + 1);
-  }
-  // headers
-  for (;;) {
+  // interim 1xx responses precede the real one: parse-and-discard (bounded)
+  for (int interim = 0; interim < 4; interim++) {
     if (!sb.read_line(&line)) return false;
-    if (line.empty()) break;
-    size_t colon = line.find(':');
-    if (colon == std::string::npos) continue;
-    std::string name = line.substr(0, colon);
-    size_t vstart = colon + 1;
-    while (vstart < line.size() && line[vstart] == ' ') vstart++;
-    msg->headers.emplace_back(name, line.substr(vstart));
-  }
-  std::string conn = lower(msg->header("connection"));
-  msg->keepalive = (msg->version == "HTTP/1.1") ? conn != "close" : conn == "keep-alive";
-  std::string te = lower(msg->header("transfer-encoding"));
-  if (!te.empty() && te != "identity") {
-    if (!is_response) return false;  // chunked requests unsupported
-    // chunked response decode
+    msg->headers.clear();
+    msg->body.clear();
+    if (is_response) {
+      // HTTP/1.1 200 OK
+      if (line.size() < 12 || line.compare(0, 5, "HTTP/") != 0) return false;
+      msg->status = std::atoi(line.c_str() + 9);
+      msg->version = line.substr(0, 8);
+    } else {
+      size_t sp1 = line.find(' ');
+      size_t sp2 = line.rfind(' ');
+      if (sp1 == std::string::npos || sp2 == sp1) return false;
+      msg->method = line.substr(0, sp1);
+      msg->target = line.substr(sp1 + 1, sp2 - sp1 - 1);
+      msg->version = line.substr(sp2 + 1);
+    }
+    // headers
     for (;;) {
       if (!sb.read_line(&line)) return false;
-      long sz = std::strtol(line.c_str(), nullptr, 16);
-      if (sz < 0) return false;
+      if (line.empty()) break;
+      size_t colon = line.find(':');
+      if (colon == std::string::npos) continue;
+      std::string name = line.substr(0, colon);
+      size_t vstart = colon + 1;
+      while (vstart < line.size() && line[vstart] == ' ') vstart++;
+      msg->headers.emplace_back(name, line.substr(vstart));
+    }
+    if (is_response && msg->status >= 100 && msg->status < 200)
+      continue;  // 1xx carries no body; the real response follows
+    break;
+  }
+  if (is_response && msg->status >= 100 && msg->status < 200)
+    return false;  // 1xx flood
+  std::string conn = lower(msg->header("connection"));
+  msg->keepalive = (msg->version == "HTTP/1.1") ? conn != "close" : conn == "keep-alive";
+  // bodyless responses: HEAD answers, 204, 304 (RFC 9110 §6.4.1)
+  if (is_response &&
+      (response_to_head || msg->status == 204 || msg->status == 304))
+    return true;
+  std::string te = lower(msg->header("transfer-encoding"));
+  if (!te.empty() && te != "identity") {
+    // chunked body decode (requests and responses)
+    for (;;) {
+      if (!sb.read_line(&line)) return false;
+      // strict hex chunk size: >=1 hex digit, then end or ';' (extensions)
+      char* endp = nullptr;
+      errno = 0;
+      long long sz = std::strtoll(line.c_str(), &endp, 16);
+      if (endp == line.c_str() || errno == ERANGE || sz < 0) return false;
+      if (*endp != '\0' && *endp != ';' && *endp != ' ' && *endp != '\r')
+        return false;
       if (sz == 0) {
         // trailers until blank line
         while (sb.read_line(&line) && !line.empty()) {
         }
         break;
       }
+      if (sz > MAX_BODY ||
+          static_cast<long long>(msg->body.size()) + sz > MAX_BODY)
+        return false;
       std::string chunk;
       if (!sb.read_exact(static_cast<size_t>(sz), &chunk)) return false;
       msg->body += chunk;
@@ -198,7 +220,7 @@ static bool read_http(SockBuf& sb, bool is_response, HttpMsg* msg,
   std::string cl = msg->header("content-length");
   if (!cl.empty()) {
     long long n = std::strtoll(cl.c_str(), nullptr, 10);
-    if (n < 0 || n > (1LL << 31)) return false;
+    if (n < 0 || n > MAX_BODY) return false;
     if (n > 0 && !sb.read_exact(static_cast<size_t>(n), &msg->body)) return false;
   }
   return true;
@@ -218,9 +240,14 @@ static std::string status_reason(int code) {
   }
 }
 
+// `cl_override`: a HEAD response's Content-Length must advertise the size the
+// corresponding GET would have (RFC 9110 §9.3.2) even though no body is sent;
+// pass the upstream's Content-Length header value there, else "" stamps
+// body.size().
 static std::string build_response(int code,
                                   const std::vector<std::pair<std::string, std::string>>& headers,
-                                  const std::string& body, bool keepalive) {
+                                  const std::string& body, bool keepalive,
+                                  const std::string& cl_override = "") {
   std::string out = "HTTP/1.1 " + std::to_string(code) + " " + status_reason(code) + "\r\n";
   bool have_ct = false;
   for (const auto& kv : headers) {
@@ -230,7 +257,8 @@ static std::string build_response(int code,
     out += kv.first + ": " + kv.second + "\r\n";
   }
   if (!have_ct) out += "Content-Type: application/json\r\n";
-  out += "Content-Length: " + std::to_string(body.size()) + "\r\n";
+  out += "Content-Length: " +
+         (cl_override.empty() ? std::to_string(body.size()) : cl_override) + "\r\n";
   out += keepalive ? "Connection: keep-alive\r\n" : "Connection: close\r\n";
   out += "\r\n";
   out += body;
@@ -530,9 +558,10 @@ struct ConnCtx {
 
   // Send req to host:port reusing a cached connection; one silent retry on a
   // stale keepalive socket. Outcomes: 0 ok, 1 connection-refused/engine-gone,
-  // 2 other failure (timeout / protocol error).
+  // 2 other failure (timeout / protocol error). `head` marks a HEAD request,
+  // whose response advertises Content-Length without sending a body.
   int roundtrip(const std::string& host, int port, const std::string& raw_req,
-                HttpMsg* resp) {
+                HttpMsg* resp, bool head = false) {
     std::string key = host + ":" + std::to_string(port);
     for (int attempt = 0; attempt < 2; attempt++) {
       bool fresh = false;
@@ -556,7 +585,7 @@ struct ConnCtx {
       SockBuf sb(fd);
       sb.buf = std::move(upstream_buf[key]);
       bool eof_clean = false;
-      if (!read_http(sb, true, resp, &eof_clean)) {
+      if (!read_http(sb, true, resp, &eof_clean, head)) {
         drop(key, fd);
         if (dp->stopping_.load()) return 2;
         if (!fresh && eof_clean) continue;  // stale keepalive
@@ -634,7 +663,7 @@ void DataPlane::handle_conn(int fd) {
       if (!have_route) {
         resp_raw = build_response(
             404, {}, envelope(false, "agent not found: " + agent_id, ""), keep);
-        if (!send_all(fd, resp_raw)) break;
+        if (!send_all(fd, resp_raw) || !keep) break;
         continue;
       }
 
@@ -676,7 +705,7 @@ void DataPlane::handle_conn(int fd) {
           resp_raw =
               build_response(503, {}, envelope(false, "agent is not running", ""), keep);
         }
-        if (!send_all(fd, resp_raw)) break;
+        if (!send_all(fd, resp_raw) || !keep) break;
         continue;
       }
 
@@ -689,7 +718,8 @@ void DataPlane::handle_conn(int fd) {
           route.host + ":" + std::to_string(route.port), e.rid, /*strip_auth=*/true);
       HttpMsg up;
       double t0 = mono_s();
-      int rc = ctx.roundtrip(route.host, route.port, upstream_req, &up);
+      int rc = ctx.roundtrip(route.host, route.port, upstream_req, &up,
+                             req.method == "HEAD");
       double dt = mono_s() - t0;
 
       bool loading = rc == 0 && up.status == 503 &&
@@ -739,9 +769,11 @@ void DataPlane::handle_conn(int fd) {
           c.lat_sum += dt;
           c.lat_max = std::max(c.lat_max, dt);
         }
-        resp_raw = build_response(up.status, up.headers, up.body, keep);
+        resp_raw = build_response(
+            up.status, up.headers, up.body, keep,
+            req.method == "HEAD" ? up.header("content-length") : "");
       }
-      if (!send_all(fd, resp_raw)) break;
+      if (!send_all(fd, resp_raw) || !keep) break;
       continue;
     }
 
@@ -750,12 +782,15 @@ void DataPlane::handle_conn(int fd) {
         req.method, req.target, req.headers, req.body,
         backend_host_ + ":" + std::to_string(backend_port_), "", /*strip_auth=*/false);
     HttpMsg up;
-    int rc = ctx.roundtrip(backend_host_, backend_port_, fwd, &up);
+    int rc = ctx.roundtrip(backend_host_, backend_port_, fwd, &up,
+                           req.method == "HEAD");
     if (rc != 0) {
       resp_raw = build_response(
           502, {}, envelope(false, "management backend unavailable", ""), keep);
     } else {
-      resp_raw = build_response(up.status, up.headers, up.body, keep);
+      resp_raw = build_response(
+          up.status, up.headers, up.body, keep,
+          req.method == "HEAD" ? up.header("content-length") : "");
     }
     if (!send_all(fd, resp_raw)) break;
     if (!keep) break;

@@ -1,6 +1,8 @@
 // Native store implementation — see store.h for the role and semantics spec.
 #include "store.h"
 
+#include <unistd.h>
+
 #include <algorithm>
 #include <cerrno>
 #include <chrono>
@@ -66,40 +68,48 @@ static void norm_range(long long n, long long* start, long long* stop) {
   if (*stop >= n) *stop = n - 1;
 }
 
+// Allowlist + key-namespace check for a single engine-originated op.
+// Returns "" when permitted, else the rejection message.
+static std::string ns_check(const Request& req, const std::string& ns) {
+  static const std::set<uint8_t> allowed = {
+      OP_SET, OP_GET, OP_DEL, OP_EXISTS, OP_KEYS, OP_EXPIRE, OP_TTL,
+      OP_RPUSH, OP_LPUSH, OP_LREM, OP_LRANGE, OP_LLEN, OP_LTRIM,
+      OP_HSET, OP_HINCRBY, OP_HGETALL, OP_PIPELINE};
+  if (!allowed.count(req.op)) return "op not allowed for engines";
+  if (req.op == OP_PIPELINE) return "";  // subs are checked individually
+  if (req.args.empty()) return "key outside agent namespace";
+  // every key arg must be namespaced: DEL takes keys in all positions,
+  // everything else keys only in arg0 (remaining args are values/indices)
+  size_t key_args = (req.op == OP_DEL) ? req.args.size() : 1;
+  for (size_t i = 0; i < key_args; i++)
+    if (req.args[i].rfind(ns, 0) != 0) return "key outside agent namespace";
+  return "";
+}
+
 std::string Store::execute(const Request& req, const std::string& ns) {
   // Namespace + allowlist enforcement for engine (UDS) callers.
   if (!ns.empty()) {
-    static const std::set<uint8_t> allowed = {
-        OP_SET, OP_GET, OP_DEL, OP_EXISTS, OP_KEYS, OP_EXPIRE, OP_TTL,
-        OP_RPUSH, OP_LPUSH, OP_LREM, OP_LRANGE, OP_LLEN, OP_LTRIM,
-        OP_HSET, OP_HINCRBY, OP_HGETALL, OP_PIPELINE};
-    if (!allowed.count(req.op)) return resp_err("op not allowed for engines");
+    std::string err = ns_check(req, ns);
+    if (!err.empty()) return resp_err(err);
     if (req.op == OP_PIPELINE) {
-      std::vector<std::string> outs;
-      for (const auto& sub_raw : req.args) {
-        Request sub;
+      // validate ALL subs (framing, nesting, allowlist, namespace) before
+      // executing ANY, so a rejected batch never partially applies — parity
+      // with the HTTP /internal/store endpoint's whole-batch 403
+      std::vector<Request> subs(req.args.size());
+      for (size_t i = 0; i < req.args.size(); i++) {
+        const auto& sub_raw = req.args[i];
         if (!parse_request(reinterpret_cast<const uint8_t*>(sub_raw.data()),
-                           sub_raw.size(), &sub))
+                           sub_raw.size(), &subs[i]))
           return resp_err("malformed pipeline entry");
-        if (sub.op == OP_PIPELINE) return resp_err("nested pipeline");
+        if (subs[i].op == OP_PIPELINE) return resp_err("nested pipeline");
+        err = ns_check(subs[i], ns);
+        if (!err.empty()) return resp_err(err);
       }
-      // validate-all-then-execute so a rejected batch never partially applies
-      for (const auto& sub_raw : req.args) {
-        Request sub;
-        parse_request(reinterpret_cast<const uint8_t*>(sub_raw.data()),
-                      sub_raw.size(), &sub);
-        std::string r = execute(sub, ns);  // recursion depth 1 (nested rejected)
-        outs.push_back(std::move(r));
-      }
+      std::vector<std::string> outs;
+      for (const auto& sub : subs)
+        outs.push_back(execute(sub, ns));  // depth 1 (nested rejected above)
       return make_response(RESP_OK, outs);
     }
-    if (req.args.empty()) return resp_err("key outside agent namespace");
-    // every key arg must be namespaced: DEL takes keys in all positions,
-    // everything else keys only in arg0 (remaining args are values/indices)
-    size_t key_args = (req.op == OP_DEL) ? req.args.size() : 1;
-    for (size_t i = 0; i < key_args; i++)
-      if (req.args[i].rfind(ns, 0) != 0)
-        return resp_err("key outside agent namespace");
   }
 
   if (req.op == OP_PIPELINE) {
@@ -497,6 +507,16 @@ void Store::aof_append(const std::string& rec) {
   std::lock_guard<std::mutex> lk(aof_mu_);
   if (!aof_) return;
   std::fwrite(rec.data(), 1, rec.size(), aof_);
+  // Durability policy: every acked write reaches the kernel page cache
+  // (fflush — survives a killed daemon), and fdatasync runs at most once per
+  // second (Redis appendfsync-everysec envelope — survives power loss minus
+  // <=1s). stdio buffering alone would lose acked journal entries on SIGKILL.
+  std::fflush(aof_);
+  double now = now_s();
+  if (now - aof_last_sync_ >= 1.0) {
+    ::fdatasync(::fileno(aof_));
+    aof_last_sync_ = now;
+  }
 }
 
 void Store::aof_flush() {

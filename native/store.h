@@ -77,6 +77,7 @@ class Store {
 
   std::mutex aof_mu_;
   std::FILE* aof_ = nullptr;
+  double aof_last_sync_ = 0;
 };
 
 }  // namespace atpu

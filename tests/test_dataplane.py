@@ -157,6 +157,138 @@ def test_native_crash_queue_resume_replay(tmp_path):
     asyncio.run(body())
 
 
+async def _raw_http(port: int, payload: bytes, timeout: float = 8.0) -> bytes:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(payload)
+    await writer.drain()
+    data = await asyncio.wait_for(reader.read(1 << 20), timeout)
+    writer.close()
+    return data
+
+
+def test_head_chunked_and_connection_close(tmp_path):
+    """HTTP edge cases the proxy must not regress vs the aiohttp front door:
+    HEAD responses carry Content-Length but no body (must not stall waiting
+    for one), chunked request bodies are decoded, and Connection: close is
+    honored on the /agent/* branch (server actually closes)."""
+
+    async def body():
+        services, task, session = await start_stack(tmp_path)
+        try:
+            resp = await session.post(
+                "/agents", json={"name": "dp-edge", "model": "echo"}, headers=AUTH
+            )
+            aid = (await resp.json())["data"]["id"]
+            await session.post(f"/agents/{aid}/start", headers=AUTH)
+            port = services.dataplane.port
+
+            # HEAD through the management forward: must answer fast, no body
+            t0 = asyncio.get_event_loop().time()
+            raw = await _raw_http(
+                port, b"HEAD /health HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n"
+            )
+            assert raw.startswith(b"HTTP/1.1 200"), raw[:80]
+            assert asyncio.get_event_loop().time() - t0 < 5.0  # no 30s body stall
+
+            # chunked request body through the proxy path
+            chat = json.dumps({"message": "chunked hello"}).encode()
+            chunked = (
+                b"POST /agent/" + aid.encode() + b"/chat HTTP/1.1\r\n"
+                b"Host: x\r\nTransfer-Encoding: chunked\r\nConnection: close\r\n\r\n"
+                + hex(len(chat))[2:].encode() + b"\r\n" + chat + b"\r\n0\r\n\r\n"
+            )
+            raw = await _raw_http(port, chunked)
+            assert raw.startswith(b"HTTP/1.1 200"), raw[:200]
+            assert b"Echo: chunked hello" in raw
+
+            # Connection: close on /agent/*: response arrives AND peer closes
+            # (read(1<<20) only returns on EOF — a pinned connection times out)
+            raw = await _raw_http(
+                port,
+                b"GET /agent/" + aid.encode() + b"/health HTTP/1.1\r\n"
+                b"Host: x\r\nConnection: close\r\n\r\n",
+            )
+            assert raw.startswith(b"HTTP/1.1 200"), raw[:80]
+            assert b"Connection: close" in raw
+
+            # malformed chunk-size line must fail the request, not silently
+            # truncate the body into a smuggled follow-up request
+            bad = (
+                b"POST /agent/" + aid.encode() + b"/chat HTTP/1.1\r\n"
+                b"Host: x\r\nTransfer-Encoding: chunked\r\n\r\n"
+                b"zz\r\n" + b"GET /agent/x HTTP/1.1\r\n\r\n"
+            )
+            raw = await _raw_http(port, bad)
+            assert not raw.startswith(b"HTTP/1.1 200"), raw[:80]
+
+            # absurd chunk size is rejected instead of buffering terabytes
+            huge = (
+                b"POST /agent/" + aid.encode() + b"/chat HTTP/1.1\r\n"
+                b"Host: x\r\nTransfer-Encoding: chunked\r\n\r\n"
+                b"7fffffffffff\r\n"
+            )
+            raw = await _raw_http(port, huge)
+            assert not raw.startswith(b"HTTP/1.1 200"), raw[:80]
+        finally:
+            await teardown(services, task, session)
+
+    asyncio.run(body())
+
+
+def test_uds_pipeline_namespace_is_atomic(tmp_path):
+    """A pipeline containing one out-of-namespace key is rejected as a whole
+    before anything executes — parity with the HTTP /internal/store 403."""
+
+    async def body():
+        services, task, session = await start_stack(tmp_path)
+        try:
+            resp = await session.post(
+                "/agents", json={"name": "dp-ns", "model": "echo"}, headers=AUTH
+            )
+            aid = (await resp.json())["data"]["id"]
+            await session.post(f"/agents/{aid}/start", headers=AUTH)
+
+            from agentainer_tpu.runtime.store_client import StoreClient
+            from agentainer_tpu.store.schema import Keys
+
+            engine_token = services.store.get(Keys.internal_token(aid))
+            assert engine_token, "engine credential missing"
+            if isinstance(engine_token, bytes):
+                engine_token = engine_token.decode()
+            assert services.backend.store_sock, "UDS store socket not wired"
+            client = StoreClient(
+                store_sock=services.backend.store_sock,
+                agent_id=aid,
+                token=engine_token,
+            )
+            try:
+                with pytest.raises(RuntimeError, match="namespace"):
+                    await client.pipeline(
+                        [
+                            {"op": "set", "key": f"agent:{aid}:mine", "value": "1"},
+                            {"op": "set", "key": "agent:other:theirs", "value": "2"},
+                            {"op": "rpush", "key": f"agent:{aid}:lst", "values": ["x"]},
+                        ]
+                    )
+                # nothing applied — not even the in-namespace prefix
+                assert services.store.get(f"agent:{aid}:mine") is None
+                assert services.store.get("agent:other:theirs") is None
+                assert services.store.lrange(f"agent:{aid}:lst", 0, -1) == []
+                # a fully in-namespace batch still works
+                res = await client.pipeline(
+                    [{"op": "set", "key": f"agent:{aid}:ok", "value": "9"}]
+                )
+                assert len(res) == 1
+                ok = services.store.get(f"agent:{aid}:ok")
+                assert (ok.decode() if isinstance(ok, bytes) else ok) == "9"
+            finally:
+                await client.close()
+        finally:
+            await teardown(services, task, session)
+
+    asyncio.run(body())
+
+
 def test_agent_records_survive_daemon_restart(tmp_path):
     """The durability tier the reference gets from Redis: stop the daemon,
     start a new one over the same AOF, agent records + journal remain."""

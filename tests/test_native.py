@@ -30,6 +30,16 @@ def reopen(aof):
 
 
 class TestAOF:
+    def test_acked_writes_reach_the_file_without_close(self, aof):
+        """SIGKILL durability: an acknowledged mutation must be flushed out
+        of stdio buffers immediately, not only on clean close."""
+        s = reopen(aof)
+        s.set("agent:durable", "survives-sigkill")
+        with open(aof, "rb") as f:  # no close()/flush() on the store first
+            data = f.read()
+        assert b"agent:durable" in data
+        s.close()
+
     def test_strings_survive_reopen(self, aof):
         s = reopen(aof)
         s.set("agent:a", json.dumps({"id": "a", "status": "running"}))

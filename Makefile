@@ -1,0 +1,43 @@
+# Ops entry points (reference Makefile parity: build/test/run/verify,
+# Makefile:29-57,186-214 — adapted to the TPU runtime: the "build" step is
+# the native C++ data plane; agents need no docker images).
+
+PY ?= python
+
+.PHONY: all native test test-native test-kernels bench server dryrun verify clean
+
+all: native
+
+# C++ store + data plane (g++; loaded via ctypes)
+native:
+	$(MAKE) -C native
+
+test: native
+	$(PY) -m pytest tests/ -q
+
+test-native: native
+	$(PY) -m pytest tests/test_native.py tests/test_dataplane.py tests/test_store.py -q
+
+test-kernels:
+	$(PY) -m pytest tests/test_pallas_attention.py tests/test_models.py -q
+
+# one JSON line: {"metric":..., "value":..., "unit":..., "vs_baseline":...}
+bench: native
+	$(PY) bench.py
+
+server: native
+	$(PY) -m agentainer_tpu.cli server
+
+# compile-check the sharded multi-chip training step on a virtual device mesh
+dryrun:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
+
+# environment smoke test (reference `make verify` spirit)
+verify:
+	@$(PY) -c "import jax; print('jax', jax.__version__, jax.default_backend(), jax.devices())"
+	@$(PY) -c "from agentainer_tpu.native import available; print('native store:', 'ok' if available() else 'MISSING')"
+
+clean:
+	$(MAKE) -C native clean 2>/dev/null || true
+	rm -rf native/build

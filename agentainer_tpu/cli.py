@@ -2,7 +2,7 @@
 
 Reference commands (cmd/agentainer/main.go:266-282): server, deploy, start,
 stop, restart, pause, resume, remove, logs, list, invoke, requests, health,
-metrics, backup {create,list,restore,delete}, audit. All lifecycle verbs are
+metrics, backup {create,list,restore,delete,export}, audit. All lifecycle verbs are
 thin HTTP clients against the management API with a bearer token
 (makeAPIRequest parity, main.go:577-613); ``server`` runs the daemon.
 
@@ -206,6 +206,18 @@ def cmd_backup(args) -> None:
     elif args.backup_cmd == "delete":
         _call(args, "DELETE", f"/backups/{args.backup_id}")
         print(f"deleted {args.backup_id}")
+    elif args.backup_cmd == "export":
+        # the server streams the tar.gz; the archive lands on THIS machine
+        url = _base(args) + f"/backups/{args.backup_id}/export"
+        resp = http.request("POST", url, headers=_headers(args), timeout=120)
+        if resp.headers.get("Content-Type", "").startswith("application/json"):
+            doc = resp.json()
+            print(f"error: {doc.get('message', resp.status_code)}", file=sys.stderr)
+            sys.exit(1)
+        out = args.output or f"{args.backup_id}.tar.gz"
+        with open(out, "wb") as f:
+            f.write(resp.content)
+        print(f"exported to {out}")
 
 
 def cmd_audit(args) -> None:
@@ -305,6 +317,9 @@ def build_parser() -> argparse.ArgumentParser:
     for name in ("restore", "delete"):
         b = bs.add_parser(name)
         b.add_argument("backup_id")
+    b = bs.add_parser("export")
+    b.add_argument("backup_id")
+    b.add_argument("-o", "--output", default="")
     bs.add_parser("list")
     s.set_defaults(fn=cmd_backup)
 

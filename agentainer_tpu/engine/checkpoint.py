@@ -35,9 +35,17 @@ def save_params(params: dict, path: str | Path) -> None:
 
 
 def load_params(cfg: ModelConfig, path: str | Path, dtype=jnp.bfloat16) -> dict:
+    """Restore weights from either supported layout: an orbax PyTree dir
+    (our own save_params) or a HuggingFace checkpoint dir (config.json +
+    *.safetensors) via engine/hf_convert.py — the deploy-any-published-
+    checkpoint path."""
+    path = Path(path).expanduser().resolve()
+    from .hf_convert import is_hf_checkpoint, load_hf_params
+
+    if is_hf_checkpoint(path):
+        return load_hf_params(cfg, path, dtype)
     import orbax.checkpoint as ocp
 
-    path = Path(path).expanduser().resolve()
     ckptr = ocp.PyTreeCheckpointer()
     restored = ckptr.restore(path / "params")
     return jax.tree.map(lambda x: jnp.asarray(x, dtype), restored)

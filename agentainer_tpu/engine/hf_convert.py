@@ -1,0 +1,160 @@
+"""HuggingFace checkpoint → engine params converter.
+
+This is the TPU-native analogue of the reference's image builder
+(pkg/docker/builder.go:98-187: turn a user-supplied artifact into a
+runnable image): here the user-supplied artifact is a HF-format Llama /
+Mixtral checkpoint directory (config.json + *.safetensors, possibly
+sharded), and "building" means mapping it onto the engine's stacked-layer
+pytree (models/llama.py) so deploy can point at any published checkpoint.
+
+Weight-name mapping (HF Llama convention → ours). HF stores projections as
+[out, in] torch Linear weights; our forward uses x @ W, so every projection
+transposes. Our RoPE is the same rotate_half layout HF ships, so q/k need
+no permutation.
+
+    model.embed_tokens.weight            → embed                [V, D]
+    …layers.{i}.input_layernorm.weight   → layers.attn_norm[i]  [D]
+    …layers.{i}.self_attn.{q,k,v}_proj   → wq/wk/wv[i]          [D, H*hd]ᵀ
+    …layers.{i}.self_attn.o_proj         → wo[i]                [H*hd, D]ᵀ
+    …layers.{i}.post_attention_layernorm → layers.mlp_norm[i]   [D]
+    …layers.{i}.mlp.{gate,up,down}_proj  → w_gate/w_up/w_down   ᵀ
+    model.norm.weight                    → final_norm           [D]
+    lm_head.weight (or tied embeddings)  → lm_head              [D, V]ᵀ
+
+Mixtral MoE:
+    …block_sparse_moe.gate               → router[i]            [D, E]ᵀ
+    …experts.{e}.w1 / w3 / w2            → w_gate/w_up/w_down[i,e]ᵀ
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.configs import ModelConfig
+
+
+def is_hf_checkpoint(path: str | Path) -> bool:
+    p = Path(path).expanduser()
+    return p.is_dir() and any(p.glob("*.safetensors"))
+
+
+def _open_shards(path: Path) -> dict:
+    """name → (shard_path). Handles single-file and index-sharded layouts."""
+    index = path / "model.safetensors.index.json"
+    if index.exists():
+        weight_map = json.loads(index.read_text())["weight_map"]
+        return {name: path / shard for name, shard in weight_map.items()}
+    shards = sorted(path.glob("*.safetensors"))
+    out: dict[str, Path] = {}
+    from safetensors import safe_open
+
+    for shard in shards:
+        with safe_open(shard, framework="np") as f:
+            for name in f.keys():
+                out[name] = shard
+    return out
+
+
+class _Loader:
+    """Lazily opens shards; tensors come out as numpy (bf16 via ml_dtypes)."""
+
+    def __init__(self, path: Path):
+        self.map = _open_shards(path)
+        self._handles: dict[Path, object] = {}
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.map
+
+    def get(self, name: str) -> np.ndarray:
+        from safetensors import safe_open
+
+        shard = self.map[name]
+        if shard not in self._handles:
+            self._handles[shard] = safe_open(shard, framework="np").__enter__()
+        return self._handles[shard].get_tensor(name)
+
+
+def config_from_hf(path: str | Path) -> ModelConfig:
+    """Derive a ModelConfig from the checkpoint's own config.json."""
+    doc = json.loads((Path(path).expanduser() / "config.json").read_text())
+    n_experts = int(doc.get("num_local_experts", 0) or 0)
+    return ModelConfig(
+        name=doc.get("model_type", "hf") + "-import",
+        vocab_size=int(doc["vocab_size"]),
+        dim=int(doc["hidden_size"]),
+        n_layers=int(doc["num_hidden_layers"]),
+        n_heads=int(doc["num_attention_heads"]),
+        n_kv_heads=int(doc.get("num_key_value_heads", doc["num_attention_heads"])),
+        ffn_dim=int(doc["intermediate_size"]),
+        max_seq_len=int(doc.get("max_position_embeddings", 8192)),
+        rope_theta=float(doc.get("rope_theta", 500_000.0)),
+        norm_eps=float(doc.get("rms_norm_eps", 1e-5)),
+        n_experts=n_experts,
+        experts_per_token=int(doc.get("num_experts_per_tok", 2)),
+    )
+
+
+def load_hf_params(
+    cfg: ModelConfig, path: str | Path, dtype: jnp.dtype = jnp.bfloat16
+) -> dict:
+    """Map a HF Llama/Mixtral checkpoint directory onto the engine pytree."""
+    p = Path(path).expanduser().resolve()
+    ld = _Loader(p)
+
+    def t(name: str) -> jnp.ndarray:  # torch Linear [out,in] → x@W layout
+        return jnp.asarray(ld.get(name)).astype(dtype).T
+
+    def vec(name: str) -> jnp.ndarray:
+        return jnp.asarray(ld.get(name)).astype(dtype)
+
+    def stack(fmt: str, transpose: bool = True) -> jnp.ndarray:
+        fn = t if transpose else vec
+        return jnp.stack([fn(fmt.format(i=i)) for i in range(cfg.n_layers)])
+
+    L = "model.layers.{i}."
+    layers = {
+        "attn_norm": stack(L + "input_layernorm.weight", transpose=False),
+        "wq": stack(L + "self_attn.q_proj.weight"),
+        "wk": stack(L + "self_attn.k_proj.weight"),
+        "wv": stack(L + "self_attn.v_proj.weight"),
+        "wo": stack(L + "self_attn.o_proj.weight"),
+        "mlp_norm": stack(L + "post_attention_layernorm.weight", transpose=False),
+    }
+    if cfg.is_moe:
+        layers["router"] = stack(L + "block_sparse_moe.gate.weight")
+
+        def experts(w: str) -> jnp.ndarray:  # [L, E, …]
+            return jnp.stack(
+                [
+                    jnp.stack(
+                        [
+                            t(f"model.layers.{i}.block_sparse_moe.experts.{e}.{w}.weight")
+                            for e in range(cfg.n_experts)
+                        ]
+                    )
+                    for i in range(cfg.n_layers)
+                ]
+            )
+
+        layers["w_gate"] = experts("w1")
+        layers["w_down"] = experts("w2")
+        layers["w_up"] = experts("w3")
+    else:
+        layers["w_gate"] = stack(L + "mlp.gate_proj.weight")
+        layers["w_up"] = stack(L + "mlp.up_proj.weight")
+        layers["w_down"] = stack(L + "mlp.down_proj.weight")
+
+    embed = jnp.asarray(ld.get("model.embed_tokens.weight")).astype(dtype)
+    lm_head = (
+        t("lm_head.weight") if "lm_head.weight" in ld else embed.T  # tied
+    )
+    return {
+        "embed": embed,
+        "layers": layers,
+        "final_norm": vec("model.norm.weight"),
+        "lm_head": lm_head,
+    }

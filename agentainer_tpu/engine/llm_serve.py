@@ -34,6 +34,7 @@ class LLMServeApp:
         self.agent_name = os.environ.get("AGENTAINER_AGENT_NAME", self.agent_id)
         self.config_name = os.environ.get("AGENTAINER_MODEL_CONFIG", "tiny")
         self.checkpoint = os.environ.get("AGENTAINER_CHECKPOINT", "")
+        self.system_prompt = os.environ.get("AGENTAINER_SYSTEM_PROMPT", "")
         self.chips = tuple(
             int(c) for c in os.environ.get("AGENTAINER_CHIPS", "0").split(",") if c != ""
         )
@@ -180,8 +181,16 @@ class LLMServeApp:
             except Exception:
                 pass
 
+        # persona parity with the reference's SYSTEM_PROMPT env
+        # (examples/gpt-agent/app.py): a brand-new session's context opens
+        # with the system prompt; later turns inherit it through the KV
+        # cache. Only the raw user message goes to /history.
+        prompt = message
+        if self.system_prompt and session not in self.engine.sessions:
+            prompt = f"{self.system_prompt}\n\n{message}"
+
         result = await self.engine.chat(
-            session=session, message=message, max_tokens=max_tokens, request_id=request_id
+            session=session, message=prompt, max_tokens=max_tokens, request_id=request_id
         )
         if self.store.connected:
             task = asyncio.ensure_future(self._snapshot_session(session))

@@ -129,11 +129,20 @@ class BackupManager:
             raise InvalidInput(f"backup not found: {backup_id}")
         path.unlink()
 
-    def export(self, backup_id: str, out_path: str | Path) -> Path:
+    def export(self, backup_id: str, out_path: str | Path | None = None) -> Path:
+        """Bundle one backup into a tar.gz. The destination is confined to
+        the daemon's ``backups/exports/`` directory — client-supplied paths
+        would otherwise be an arbitrary-file-overwrite primitive for any
+        bearer-token holder; the HTTP layer streams the bytes back instead."""
         path = self._path(backup_id)
         if not path.exists():
             raise InvalidInput(f"backup not found: {backup_id}")
-        out = Path(out_path)
+        exports = self.dir / "exports"
+        exports.mkdir(parents=True, exist_ok=True)
+        name = Path(str(out_path)).name if out_path else f"{backup_id}.tar.gz"
+        if not name.endswith(".tar.gz"):
+            name += ".tar.gz"
+        out = exports / name
         with tarfile.open(out, "w:gz") as tar:
             tar.add(path, arcname=path.name)
         return out

@@ -172,6 +172,7 @@ class ControlPlaneApp:
         r.add_post("/backups", self.h_backup_create)
         r.add_get("/backups", self.h_backup_list)
         r.add_post("/backups/{backup_id}/restore", self.h_backup_restore)
+        r.add_post("/backups/{backup_id}/export", self.h_backup_export)
         r.add_delete("/backups/{backup_id}", self.h_backup_delete)
 
     # -- helpers ---------------------------------------------------------
@@ -465,6 +466,22 @@ class ControlPlaneApp:
         restored = await self._mgr(self.s.backups.restore, backup_id)
         self._audit(request, "backup-restore", backup_id, "success")
         return ok(restored, message="Backup restored")
+
+    async def h_backup_export(self, request: web.Request) -> web.StreamResponse:
+        """Bundle one backup into a portable tar.gz (manager.go:397-456
+        parity) and STREAM the bytes to the caller — the archive lands on
+        the client's machine, and the daemon never writes a client-chosen
+        server-side path."""
+        backup_id = request.match_info["backup_id"]
+        exported = await self._mgr(self.s.backups.export, backup_id)
+        self._audit(request, "backup-export", backup_id, "success")
+        return web.FileResponse(
+            exported,
+            headers={
+                "Content-Type": "application/gzip",
+                "Content-Disposition": f'attachment; filename="{exported.name}"',
+            },
+        )
 
     async def h_backup_delete(self, request: web.Request) -> web.Response:
         backup_id = request.match_info["backup_id"]

@@ -22,12 +22,22 @@ Store::Store(const std::string& aof_path) {
   if (!aof_path.empty()) {
     aof_load(aof_path);
     aof_ = std::fopen(aof_path.c_str(), "ab");
+    if (aof_) sync_thread_ = std::thread(&Store::aof_sync_loop, this);
   }
 }
 
 Store::~Store() {
+  if (sync_thread_.joinable()) {
+    {
+      std::lock_guard<std::mutex> lk(sync_mu_);
+      sync_stop_ = true;
+    }
+    sync_cv_.notify_all();
+    sync_thread_.join();
+  }
   if (aof_) {
     std::fflush(aof_);
+    ::fdatasync(::fileno(aof_));
     std::fclose(aof_);
   }
 }
@@ -508,14 +518,29 @@ void Store::aof_append(const std::string& rec) {
   if (!aof_) return;
   std::fwrite(rec.data(), 1, rec.size(), aof_);
   // Durability policy: every acked write reaches the kernel page cache
-  // (fflush — survives a killed daemon), and fdatasync runs at most once per
-  // second (Redis appendfsync-everysec envelope — survives power loss minus
-  // <=1s). stdio buffering alone would lose acked journal entries on SIGKILL.
+  // (fflush — survives a killed daemon); fdatasync runs off the write path
+  // on the background sync thread about once a second (Redis
+  // appendfsync-everysec envelope — survives power loss minus <=1s). stdio
+  // buffering alone would lose acked journal entries on SIGKILL.
   std::fflush(aof_);
-  double now = now_s();
-  if (now - aof_last_sync_ >= 1.0) {
-    ::fdatasync(::fileno(aof_));
-    aof_last_sync_ = now;
+  aof_dirty_.store(true, std::memory_order_release);
+}
+
+void Store::aof_sync_loop() {
+  std::unique_lock<std::mutex> lk(sync_mu_);
+  while (!sync_stop_) {
+    // steady clock via condition_variable wait_for: immune to wall-clock
+    // steps (NTP), unlike a now_s()-based cadence
+    sync_cv_.wait_for(lk, std::chrono::seconds(1), [this] { return sync_stop_; });
+    if (sync_stop_) break;
+    if (!aof_dirty_.exchange(false, std::memory_order_acq_rel)) continue;
+    int fd = -1;
+    {
+      std::lock_guard<std::mutex> alk(aof_mu_);
+      if (aof_) fd = ::fileno(aof_);
+    }
+    // sync outside aof_mu_ so writers never stall behind disk latency
+    if (fd >= 0) ::fdatasync(fd);
   }
 }
 

@@ -10,8 +10,10 @@
 // glob-pattern pub/sub.
 #pragma once
 
+#include <atomic>
 #include <condition_variable>
 #include <cstdio>
+#include <thread>
 #include <deque>
 #include <map>
 #include <memory>
@@ -77,7 +79,13 @@ class Store {
 
   std::mutex aof_mu_;
   std::FILE* aof_ = nullptr;
-  double aof_last_sync_ = 0;
+  // everysec fdatasync runs on its own thread (see aof_sync_loop)
+  void aof_sync_loop();
+  std::atomic<bool> aof_dirty_{false};
+  std::thread sync_thread_;
+  std::mutex sync_mu_;
+  std::condition_variable sync_cv_;
+  bool sync_stop_ = false;
 };
 
 }  // namespace atpu

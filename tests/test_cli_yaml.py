@@ -46,6 +46,22 @@ spec:
 """
 
 
+def test_shipped_examples_parse():
+    """The example fleets in examples/ must stay loadable."""
+    import pathlib
+
+    from agentainer_tpu.manager.deployconfig import fan_out
+
+    root = pathlib.Path(__file__).resolve().parent.parent / "examples"
+    yamls = sorted(root.glob("*.yaml"))
+    assert yamls, "examples/ should ship deployment YAMLs"
+    for path in yamls:
+        config = load_deployment(str(path))
+        assert config.agents
+        for spec in config.agents:
+            assert list(fan_out(spec))
+
+
 def test_parse_quantity():
     assert parse_quantity("2G") == 2 * 1000**3
     assert parse_quantity("2Gi") == 2 * 1024**3

@@ -1,0 +1,153 @@
+"""HF-checkpoint import: weight-name mapping, transposes, tied embeddings,
+MoE expert stacking, and the load_params format dispatch."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agentainer_tpu.models.configs import get_config
+from agentainer_tpu.models.llama import forward, init_params
+
+
+def _write_hf_llama(tmp_path, cfg, tied=False, seed=0):
+    from safetensors.numpy import save_file
+
+    rng = np.random.default_rng(seed)
+    d, hd = cfg.dim, cfg.head_dim
+
+    def w(*shape):
+        return rng.standard_normal(shape).astype(np.float32) * 0.02
+
+    tensors = {
+        "model.embed_tokens.weight": w(cfg.vocab_size, d),
+        "model.norm.weight": np.ones(d, np.float32),
+    }
+    if not tied:
+        tensors["lm_head.weight"] = w(cfg.vocab_size, d)
+    for i in range(cfg.n_layers):
+        L = f"model.layers.{i}."
+        tensors[L + "input_layernorm.weight"] = np.ones(d, np.float32)
+        tensors[L + "post_attention_layernorm.weight"] = np.ones(d, np.float32)
+        tensors[L + "self_attn.q_proj.weight"] = w(cfg.n_heads * hd, d)
+        tensors[L + "self_attn.k_proj.weight"] = w(cfg.n_kv_heads * hd, d)
+        tensors[L + "self_attn.v_proj.weight"] = w(cfg.n_kv_heads * hd, d)
+        tensors[L + "self_attn.o_proj.weight"] = w(d, cfg.n_heads * hd)
+        if cfg.is_moe:
+            tensors[L + "block_sparse_moe.gate.weight"] = w(cfg.n_experts, d)
+            for e in range(cfg.n_experts):
+                E = L + f"block_sparse_moe.experts.{e}."
+                tensors[E + "w1.weight"] = w(cfg.ffn_dim, d)
+                tensors[E + "w2.weight"] = w(d, cfg.ffn_dim)
+                tensors[E + "w3.weight"] = w(cfg.ffn_dim, d)
+        else:
+            tensors[L + "mlp.gate_proj.weight"] = w(cfg.ffn_dim, d)
+            tensors[L + "mlp.up_proj.weight"] = w(cfg.ffn_dim, d)
+            tensors[L + "mlp.down_proj.weight"] = w(d, cfg.ffn_dim)
+    save_file(tensors, str(tmp_path / "model.safetensors"))
+    (tmp_path / "config.json").write_text(
+        json.dumps(
+            {
+                "model_type": "llama",
+                "vocab_size": cfg.vocab_size,
+                "hidden_size": cfg.dim,
+                "num_hidden_layers": cfg.n_layers,
+                "num_attention_heads": cfg.n_heads,
+                "num_key_value_heads": cfg.n_kv_heads,
+                "intermediate_size": cfg.ffn_dim,
+                "rope_theta": cfg.rope_theta,
+                "rms_norm_eps": cfg.norm_eps,
+                **(
+                    {
+                        "num_local_experts": cfg.n_experts,
+                        "num_experts_per_tok": cfg.experts_per_token,
+                    }
+                    if cfg.is_moe
+                    else {}
+                ),
+            }
+        )
+    )
+    return tensors
+
+
+def test_llama_mapping_and_forward(tmp_path):
+    cfg = get_config("tiny")
+    tensors = _write_hf_llama(tmp_path, cfg)
+
+    from agentainer_tpu.engine.checkpoint import load_params
+
+    params = load_params(cfg, tmp_path, dtype=jnp.float32)
+
+    # pytree shape parity with random init
+    ref = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    assert jax.tree.structure(params) == jax.tree.structure(ref)
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_leaves_with_path(params),
+        jax.tree_util.tree_leaves_with_path(ref),
+    ):
+        assert a.shape == b.shape, (pa, a.shape, b.shape)
+
+    # spot-check the transpose convention on layer 1
+    np.testing.assert_allclose(
+        np.asarray(params["layers"]["wq"][1]),
+        tensors["model.layers.1.self_attn.q_proj.weight"].T,
+        rtol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(params["lm_head"]), tensors["lm_head.weight"].T, rtol=1e-6
+    )
+
+    # imported params drive a real forward pass
+    tokens = jnp.zeros((1, 4), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(4), (1, 4))
+    logits, _ = forward(params, cfg, tokens, positions)
+    assert logits.shape == (1, 4, cfg.vocab_size)
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_tied_embeddings(tmp_path):
+    cfg = get_config("tiny")
+    tensors = _write_hf_llama(tmp_path, cfg, tied=True)
+    from agentainer_tpu.engine.hf_convert import load_hf_params
+
+    params = load_hf_params(cfg, tmp_path, dtype=jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(params["lm_head"]),
+        tensors["model.embed_tokens.weight"].T,
+        rtol=1e-6,
+    )
+
+
+def test_moe_expert_stacking(tmp_path):
+    cfg = get_config("tiny-moe")
+    tensors = _write_hf_llama(tmp_path, cfg)
+    from agentainer_tpu.engine.hf_convert import load_hf_params
+
+    params = load_hf_params(cfg, tmp_path, dtype=jnp.float32)
+    assert params["layers"]["w_gate"].shape == (
+        cfg.n_layers, cfg.n_experts, cfg.dim, cfg.ffn_dim,
+    )
+    np.testing.assert_allclose(
+        np.asarray(params["layers"]["w_down"][0, 1]),
+        tensors["model.layers.0.block_sparse_moe.experts.1.w2.weight"].T,
+        rtol=1e-6,
+    )
+    tokens = jnp.zeros((1, 4), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(4), (1, 4))
+    logits, _ = forward(params, cfg, tokens, positions)
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_config_from_hf(tmp_path):
+    cfg = get_config("tiny")
+    _write_hf_llama(tmp_path, cfg)
+    from agentainer_tpu.engine.hf_convert import config_from_hf
+
+    derived = config_from_hf(tmp_path)
+    assert derived.dim == cfg.dim
+    assert derived.n_layers == cfg.n_layers
+    assert derived.n_kv_heads == cfg.n_kv_heads
+    assert not derived.is_moe

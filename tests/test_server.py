@@ -337,8 +337,24 @@ def test_backup_create_restore(tmp_path):
         convo = services.store.lrange(Keys.conversations(restored[0]["id"]), 0, -1)
         assert convo == [b'{"role":"user","content":"hi"}']
 
+        # export streams a portable tar.gz to the client (manager.go:397-456);
+        # the daemon never writes a client-chosen path
+        resp = await client.post(f"/backups/{backup['id']}/export", headers=AUTH)
+        assert resp.status == 200, await resp.text()
+        assert resp.headers["Content-Type"] == "application/gzip"
+        blob = await resp.read()
+        out = tmp_path / "bundle.tar.gz"
+        out.write_bytes(blob)
+        import tarfile
+
+        with tarfile.open(out) as tar:
+            assert any(m.name.endswith(".json") for m in tar.getmembers())
+
         resp = await client.delete(f"/backups/{backup['id']}", headers=AUTH)
         assert resp.status == 200
+        # export of a deleted backup → 400 envelope
+        resp = await client.post(f"/backups/{backup['id']}/export", headers=AUTH)
+        assert resp.status == 400
         await client.close()
 
     run(body())

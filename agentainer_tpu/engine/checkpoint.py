@@ -48,7 +48,9 @@ def load_params(cfg: ModelConfig, path: str | Path, dtype=jnp.bfloat16) -> dict:
 
     ckptr = ocp.PyTreeCheckpointer()
     restored = ckptr.restore(path / "params")
-    return jax.tree.map(lambda x: jnp.asarray(x, dtype), restored)
+    # host-side cast: the engine device_puts with its target sharding, so a
+    # TP-sharded model never materializes whole on one chip
+    return jax.tree.map(lambda x: np.asarray(x).astype(dtype), restored)
 
 
 # -- KV slot snapshots (engine ↔ store) ---------------------------------

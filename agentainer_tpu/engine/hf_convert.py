@@ -101,19 +101,24 @@ def config_from_hf(path: str | Path) -> ModelConfig:
 def load_hf_params(
     cfg: ModelConfig, path: str | Path, dtype: jnp.dtype = jnp.bfloat16
 ) -> dict:
-    """Map a HF Llama/Mixtral checkpoint directory onto the engine pytree."""
+    """Map a HF Llama/Mixtral checkpoint directory onto the engine pytree.
+
+    Returns HOST (numpy, ml_dtypes-backed for bf16) arrays: the engine
+    device_puts them with its target sharding, so a TP-sharded model is
+    never materialized whole on one chip's HBM — required when the weights
+    only fit *because* of TP."""
     p = Path(path).expanduser().resolve()
     ld = _Loader(p)
 
-    def t(name: str) -> jnp.ndarray:  # torch Linear [out,in] → x@W layout
-        return jnp.asarray(ld.get(name)).astype(dtype).T
+    def t(name: str) -> np.ndarray:  # torch Linear [out,in] → x@W layout
+        return np.asarray(ld.get(name)).astype(dtype).T
 
-    def vec(name: str) -> jnp.ndarray:
-        return jnp.asarray(ld.get(name)).astype(dtype)
+    def vec(name: str) -> np.ndarray:
+        return np.asarray(ld.get(name)).astype(dtype)
 
-    def stack(fmt: str, transpose: bool = True) -> jnp.ndarray:
+    def stack(fmt: str, transpose: bool = True) -> np.ndarray:
         fn = t if transpose else vec
-        return jnp.stack([fn(fmt.format(i=i)) for i in range(cfg.n_layers)])
+        return np.stack([fn(fmt.format(i=i)) for i in range(cfg.n_layers)])
 
     L = "model.layers.{i}."
     layers = {
@@ -127,10 +132,10 @@ def load_hf_params(
     if cfg.is_moe:
         layers["router"] = stack(L + "block_sparse_moe.gate.weight")
 
-        def experts(w: str) -> jnp.ndarray:  # [L, E, …]
-            return jnp.stack(
+        def experts(w: str) -> np.ndarray:  # [L, E, …]
+            return np.stack(
                 [
-                    jnp.stack(
+                    np.stack(
                         [
                             t(f"model.layers.{i}.block_sparse_moe.experts.{e}.{w}.weight")
                             for e in range(cfg.n_experts)
@@ -148,7 +153,7 @@ def load_hf_params(
         layers["w_up"] = stack(L + "mlp.up_proj.weight")
         layers["w_down"] = stack(L + "mlp.down_proj.weight")
 
-    embed = jnp.asarray(ld.get("model.embed_tokens.weight")).astype(dtype)
+    embed = np.asarray(ld.get("model.embed_tokens.weight")).astype(dtype)
     lm_head = (
         t("lm_head.weight") if "lm_head.weight" in ld else embed.T  # tied
     )

@@ -100,15 +100,47 @@ class LLMEngine:
         max_batch: int,
         max_seq: int,
         decode_chunk: int = 8,
+        tp: int = 1,
+        devices: list | None = None,
     ):
         self.cfg = cfg
-        self.params = params
         self.tokenizer = tokenizer
         self.max_batch = max_batch
         self.max_seq = max_seq
         self.decode_chunk = max(1, decode_chunk)
+        self.tp = max(1, tp)
         self.scratch_pos = max_seq - 1  # idle-slot write target; never generated into
-        self.cache = KVCache.create(cfg, max_batch, max_seq, dtype=params["embed"].dtype)
+        dtype = jax.tree.leaves(params)[0].dtype
+        cache_shape = (cfg.n_layers, max_batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
+        if self.tp > 1:
+            # serve-time tensor parallelism: Megatron-style GSPMD shardings
+            # over a 1×tp mesh on the agent's ASSIGNED chips — heads/FFN
+            # width split across them, KV arena split on the kv-head axis;
+            # XLA inserts the ICI collectives. (DP scale-out stays at the
+            # control plane via `replicas: N`, matching the reference's
+            # fan-out.) Params arrive host-side and are device_put directly
+            # with their shardings, and the arena is allocated sharded, so
+            # nothing is ever materialized whole on one chip.
+            from jax.sharding import NamedSharding
+
+            from ..parallel.mesh import make_mesh
+            from ..parallel.sharding import cache_specs, param_shardings
+
+            self.mesh = make_mesh(self.tp, tp=self.tp, devices=devices)
+            params = jax.device_put(params, param_shardings(self.mesh, cfg.is_moe))
+            cache_sh = NamedSharding(self.mesh, cache_specs())
+            cache = jax.jit(
+                lambda: KVCache(
+                    jnp.zeros(cache_shape, dtype), jnp.zeros(cache_shape, dtype)
+                ),
+                out_shardings=KVCache(cache_sh, cache_sh),
+            )()
+        else:
+            self.mesh = None
+            params = jax.device_put(params)  # checkpoint loads arrive host-side
+            cache = KVCache.create(cfg, max_batch, max_seq, dtype=dtype)
+        self.params = params
+        self.cache = cache
         self.slots = [Slot(i) for i in range(max_batch)]
         self.sessions: dict[str, int] = {}
 
@@ -153,8 +185,38 @@ class LLMEngine:
         max_batch = int(options.get("max_batch", 8))
         max_seq = int(options.get("max_seq", min(cfg.max_seq_len, 2048)))
         decode_chunk = int(options.get("decode_chunk", 8))
+        # serve-time TP: the control plane passes the agent's assigned chip
+        # ids (llm_serve); clamp to the visible devices and to a divisor of
+        # the model's head counts. Standalone default is single-chip.
+        from ..parallel.mesh import pick_tp
+
+        all_devices = jax.devices()
+        chips = [int(c) for c in options.get("chips", []) or []]
+        tp_req = max(1, int(options.get("tp", 0) or len(chips) or 1))
+        tp = pick_tp(cfg, min(tp_req, len(all_devices)))
+        if tp != tp_req:
+            print(
+                f"[llm-engine] tp degraded {tp_req} -> {tp} "
+                f"(visible devices={len(all_devices)}, model kv_heads="
+                f"{cfg.n_kv_heads}, heads={cfg.n_heads}); extra chips idle",
+                flush=True,
+            )
+        # the mesh spans the ASSIGNED chips when their ids map to visible
+        # devices (multi-chip host); engines on a tunneled/virtual platform
+        # fall back to the first tp devices
+        if chips and len(chips) >= tp and all(c < len(all_devices) for c in chips):
+            devices = [all_devices[c] for c in chips[:tp]]
+        else:
+            devices = list(all_devices[:tp])
         engine = cls(
-            cfg, params, tokenizer, max_batch=max_batch, max_seq=max_seq, decode_chunk=decode_chunk
+            cfg,
+            params,
+            tokenizer,
+            max_batch=max_batch,
+            max_seq=max_seq,
+            decode_chunk=decode_chunk,
+            tp=tp,
+            devices=devices,
         )
         # pay the decode/prefill compiles here (inside the loader thread, while
         # /health keeps answering) instead of on the first user request
@@ -163,12 +225,17 @@ class LLMEngine:
 
     def _build_compiled(self) -> None:
         cfg = self.cfg
+        # GSPMD cannot auto-partition a pallas_call: the Pallas kernels serve
+        # the single-chip path; TP shards the einsum path on the head axis
+        use_flash = self.tp == 1
 
         def prefill(params, cache, slot, tokens, positions, n_real):
             # slice the slot's cache row, run the prompt, write the row back
             rowk = lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1)
             rowv = lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1)
-            logits, row = forward(params, cfg, tokens, positions, KVCache(rowk, rowv))
+            logits, row = forward(
+                params, cfg, tokens, positions, KVCache(rowk, rowv), use_flash=use_flash
+            )
             newk = lax.dynamic_update_slice_in_dim(cache.k, row.k, slot, axis=1)
             newv = lax.dynamic_update_slice_in_dim(cache.v, row.v, slot, axis=1)
             last = lax.dynamic_slice_in_dim(logits, n_real - 1, 1, axis=1)[0, 0]
@@ -183,7 +250,9 @@ class LLMEngine:
 
             def step(carry, key):
                 tok, pos, cache = carry
-                logits, cache = forward(params, cfg, tok[:, None], pos[:, None], cache)
+                logits, cache = forward(
+                    params, cfg, tok[:, None], pos[:, None], cache, use_flash=use_flash
+                )
                 nxt = sample(logits[:, 0], key, temperature=temps)
                 return (nxt, pos + 1, cache), nxt
 
@@ -325,6 +394,7 @@ class LLMEngine:
             "active_sessions": len(self.sessions),
             "max_batch": self.max_batch,
             "max_seq": self.max_seq,
+            "tp": self.tp,
         }
 
     def shutdown(self) -> None:

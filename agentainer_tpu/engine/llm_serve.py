@@ -78,6 +78,10 @@ class LLMServeApp:
                 checkpoint=self.checkpoint,
                 agent_id=self.agent_id,
                 store=self.store,
+                # TP spans the chips the slice scheduler assigned this agent
+                options={"tp": len(self.chips), "chips": list(self.chips)}
+                if self.chips
+                else None,
             )
         except BaseException as e:  # engine stays None; /chat reports 503
             self.engine_error = f"{type(e).__name__}: {e}"

@@ -136,7 +136,7 @@ def _attention_block(
         batch_idx = jnp.arange(b)[:, None]
         ck = ck.at[batch_idx, positions].set(k)
         cv = cv.at[batch_idx, positions].set(v)
-        attn = cache_attention(q, ck, cv, positions)
+        attn = cache_attention(q, ck, cv, positions, use_pallas=use_flash)
     elif use_flash:
         attn = flash_attention(q, k, v, causal=True)
     else:

@@ -96,14 +96,18 @@ def cache_attention(
     ck: jnp.ndarray,  # [B, S, KV, hd] arena (slots >= positions are unwritten)
     cv: jnp.ndarray,  # [B, S, KV, hd]
     positions: jnp.ndarray,  # [B, T] int32 per-sequence absolute positions
+    use_pallas: bool = True,
 ) -> jnp.ndarray:
     """Attention over the KV arena: row t sees slot j iff j <= positions[b,t].
 
     This is the serving hot path (both ragged cached prefill and T==1
     decode). On TPU it dispatches to the Pallas flash kernels, which build
     the mask in-register; elsewhere it materializes ``cache_mask`` and runs
-    the XLA reference."""
-    if _use_pallas(q.shape[2], ck.shape[2], q.shape[3]):
+    the XLA reference. Callers running under GSPMD sharding (TP-sharded
+    engine) pass ``use_pallas=False`` — XLA cannot auto-partition a
+    pallas_call, while it shards the einsum path along the head axis for
+    free."""
+    if use_pallas and _use_pallas(q.shape[2], ck.shape[2], q.shape[3]):
         from .pallas_attention import flash_decode, flash_prefill
 
         if q.shape[1] == 1:

@@ -110,7 +110,7 @@ class LLMEngine:
         self.decode_chunk = max(1, decode_chunk)
         self.tp = max(1, tp)
         self.scratch_pos = max_seq - 1  # idle-slot write target; never generated into
-        dtype = jax.tree.leaves(params)[0].dtype
+        dtype = params["final_norm"].dtype  # always dense, even when quantized
         cache_shape = (cfg.n_layers, max_batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
         if self.tp > 1:
             # serve-time tensor parallelism: Megatron-style GSPMD shardings
@@ -137,8 +137,16 @@ class LLMEngine:
             )()
         else:
             self.mesh = None
-            params = jax.device_put(params)  # checkpoint loads arrive host-side
-            cache = KVCache.create(cfg, max_batch, max_seq, dtype=dtype)
+            # single-chip: place on the ASSIGNED chip, not the default
+            # device — on a multi-chip host two agents with different
+            # single-chip slices must not both land on device 0
+            dev = devices[0] if devices else None
+            params = jax.device_put(params, dev)  # checkpoint loads arrive host-side
+            if dev is not None:
+                with jax.default_device(dev):
+                    cache = KVCache.create(cfg, max_batch, max_seq, dtype=dtype)
+            else:
+                cache = KVCache.create(cfg, max_batch, max_seq, dtype=dtype)
         self.params = params
         self.cache = cache
         self.slots = [Slot(i) for i in range(max_batch)]
@@ -176,29 +184,30 @@ class LLMEngine:
         cfg = get_config(config_name or "tiny")
         tokenizer = load_tokenizer(cfg.vocab_size, checkpoint)
         dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
-        if checkpoint:
-            from .checkpoint import load_params
+        quant = str(options.get("quant", "") or "").lower()
+        if quant and quant != "int8":
+            raise ValueError(f"unknown quant scheme {quant!r} (supported: int8)")
 
-            params = load_params(cfg, checkpoint, dtype=dtype)
-        else:
-            params = init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
-        max_batch = int(options.get("max_batch", 8))
-        max_seq = int(options.get("max_seq", min(cfg.max_seq_len, 2048)))
-        decode_chunk = int(options.get("decode_chunk", 8))
         # serve-time TP: the control plane passes the agent's assigned chip
         # ids (llm_serve); clamp to the visible devices and to a divisor of
         # the model's head counts. Standalone default is single-chip.
+        # quant=int8's pytree doesn't match the TP sharding specs, so it
+        # degrades to one chip the same way non-dividing head counts do.
         from ..parallel.mesh import pick_tp
 
         all_devices = jax.devices()
         chips = [int(c) for c in options.get("chips", []) or []]
         tp_req = max(1, int(options.get("tp", 0) or len(chips) or 1))
         tp = pick_tp(cfg, min(tp_req, len(all_devices)))
+        if quant:
+            tp = 1
         if tp != tp_req:
             print(
                 f"[llm-engine] tp degraded {tp_req} -> {tp} "
                 f"(visible devices={len(all_devices)}, model kv_heads="
-                f"{cfg.n_kv_heads}, heads={cfg.n_heads}); extra chips idle",
+                f"{cfg.n_kv_heads}, heads={cfg.n_heads}"
+                + (", quant=int8 is single-chip" if quant else "")
+                + "); extra chips idle",
                 flush=True,
             )
         # the mesh spans the ASSIGNED chips when their ids map to visible
@@ -208,6 +217,33 @@ class LLMEngine:
             devices = [all_devices[c] for c in chips[:tp]]
         else:
             devices = list(all_devices[:tp])
+
+        if checkpoint:
+            from .checkpoint import load_params
+
+            params = load_params(cfg, checkpoint, dtype=dtype)  # host-side
+        elif quant:
+            # random init on the HOST when quantizing: the dense bf16 model
+            # may be exactly what doesn't fit the chip
+            try:
+                cpu0 = jax.local_devices(backend="cpu")[0]
+            except Exception:
+                cpu0 = None
+            if cpu0 is not None:
+                with jax.default_device(cpu0):
+                    params = init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+            else:
+                params = init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+        else:
+            params = init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+        if quant:
+            from .quant import quantize_params
+
+            # host-side: only the int8 model ever reaches HBM
+            params = quantize_params(params, dtype)
+        max_batch = int(options.get("max_batch", 8))
+        max_seq = int(options.get("max_seq", min(cfg.max_seq_len, 2048)))
+        decode_chunk = int(options.get("decode_chunk", 8))
         engine = cls(
             cfg,
             params,

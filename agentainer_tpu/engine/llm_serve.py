@@ -35,6 +35,12 @@ class LLMServeApp:
         self.config_name = os.environ.get("AGENTAINER_MODEL_CONFIG", "tiny")
         self.checkpoint = os.environ.get("AGENTAINER_CHECKPOINT", "")
         self.system_prompt = os.environ.get("AGENTAINER_SYSTEM_PROMPT", "")
+        try:
+            self.model_options = json.loads(
+                os.environ.get("AGENTAINER_MODEL_OPTIONS", "") or "{}"
+            )
+        except json.JSONDecodeError:
+            self.model_options = {}
         self.chips = tuple(
             int(c) for c in os.environ.get("AGENTAINER_CHIPS", "0").split(",") if c != ""
         )
@@ -67,6 +73,13 @@ class LLMServeApp:
         except Exception:
             pass
 
+    def _engine_options(self) -> dict:
+        opts = dict(self.model_options)
+        if self.chips:
+            opts.setdefault("tp", len(self.chips))
+            opts["chips"] = list(self.chips)
+        return opts
+
     def _load_engine(self) -> None:
         """Build the JAX engine (slow: compile + weight init). Runs in a
         thread at startup so /health can answer while loading."""
@@ -78,10 +91,10 @@ class LLMServeApp:
                 checkpoint=self.checkpoint,
                 agent_id=self.agent_id,
                 store=self.store,
-                # TP spans the chips the slice scheduler assigned this agent
-                options={"tp": len(self.chips), "chips": list(self.chips)}
-                if self.chips
-                else None,
+                # deploy-time knobs (quant/max_batch/…); the scheduler's
+                # chip assignment always rides along (placement authority),
+                # while an explicit options.tp can narrow the span
+                options=self._engine_options(),
             )
         except BaseException as e:  # engine stays None; /chat reports 503
             self.engine_error = f"{type(e).__name__}: {e}"

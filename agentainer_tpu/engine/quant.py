@@ -1,0 +1,49 @@
+"""Int8 weight-only quantization for serving (llama-pytree aware).
+
+Why: Llama-3-8B in bf16 is ~16 GB of weights — a whole v5e chip's HBM,
+leaving nothing for the KV arena. Weight-only int8 halves that (8 GB), so
+the 8B flagship serves on ONE chip with a real cache (BASELINE.json
+config #2 without requiring a multi-chip slice), and halves the weight
+HBM→VMEM streaming that bounds decode throughput.
+
+Deploy with ``model.options.quant: int8``. Quantization runs host-side
+over the checkpoint arrays; norm vectors keep the working dtype (tiny,
+precision-critical). Dequantization happens per layer slice inside the
+model's scan (models/llama.py) — only the current layer is ever dense.
+
+Core tensor type lives in ops/quant.py (model-agnostic).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.quant import QTensor, dequant, quantize_array  # noqa: F401 (re-export)
+
+# matmul weights to quantize, by pytree key; norms keep their dtype
+_QUANT_KEYS = {
+    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "router",
+    "embed", "lm_head",
+}
+
+
+def quantize_params(params: dict, dtype=jnp.bfloat16) -> dict:
+    """Quantize the matmul weights of a models/llama.py pytree (host-side
+    input recommended — the dense model then never touches HBM)."""
+    out: dict = {}
+    for key, val in params.items():
+        if isinstance(val, dict):
+            out[key] = quantize_params(val, dtype)
+        elif key in _QUANT_KEYS:
+            out[key] = quantize_array(val, dtype)
+        else:
+            out[key] = jnp.asarray(np.asarray(val).astype(dtype))
+    return out
+
+
+def param_bytes_actual(params: dict) -> int:
+    """Byte footprint of the (possibly quantized) pytree."""
+    import jax
+
+    return sum(leaf.size * leaf.dtype.itemsize for leaf in jax.tree.leaves(params))

@@ -27,6 +27,7 @@ from jax import lax
 
 from ..ops.attention import attention_reference, cache_attention, causal_mask, flash_attention
 from ..ops.norms import rms_norm
+from ..ops.quant import dequant, embed_lookup
 from ..ops.rope import apply_rope
 from .configs import ModelConfig
 
@@ -159,7 +160,7 @@ def forward(
     per-sequence positions — the continuous-batching engine relies on this.
     Without: pure causal self-attention (training / eval).
     """
-    x = params["embed"][tokens].astype(params["embed"].dtype)
+    x = embed_lookup(params["embed"], tokens)
     if cache is not None:
         mask = None  # cache_attention masks from positions (in-kernel on TPU)
     else:
@@ -172,9 +173,15 @@ def forward(
         x = carry
         if cache is not None:
             lp, ck, cv = inputs
-            x, ck, cv = _attention_block(x, lp, cfg, positions, mask, ck, cv, use_flash)
         else:
             lp = inputs
+        # int8-quantized weights (engine/quant.py) dequantize per layer
+        # slice here: HBM holds the int8 stack, only the current layer is
+        # dense, and XLA fuses the convert into the consuming matmuls
+        lp = {k: dequant(v) for k, v in lp.items()}
+        if cache is not None:
+            x, ck, cv = _attention_block(x, lp, cfg, positions, mask, ck, cv, use_flash)
+        else:
             x, _, _ = _attention_block(x, lp, cfg, positions, mask, None, None, use_flash)
             ck = cv = jnp.zeros((0,), x.dtype)  # scan needs a leaf
         h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
@@ -192,7 +199,7 @@ def forward(
         new_cache = None
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = (x @ dequant(params["lm_head"])).astype(jnp.float32)
     return logits, new_cache
 
 

@@ -23,6 +23,7 @@ fight over the same chips.
 
 from __future__ import annotations
 
+import json
 import os
 import signal
 import socket
@@ -123,6 +124,9 @@ class LocalBackend(Backend):
                 "AGENTAINER_ENGINE": agent.model.engine,
                 "AGENTAINER_MODEL_CONFIG": agent.model.config,
                 "AGENTAINER_CHECKPOINT": agent.model.checkpoint,
+                # engine tuning knobs (quant/max_batch/max_seq/…) ride the
+                # same env channel the reference uses for container config
+                "AGENTAINER_MODEL_OPTIONS": json.dumps(agent.model.options or {}),
                 "AGENTAINER_PORT": str(port),
                 "AGENTAINER_CHIPS": ",".join(map(str, chips)),
                 "AGENTAINER_CONTROL_URL": self.control_url,

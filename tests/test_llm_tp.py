@@ -41,6 +41,23 @@ def test_tp_engine_shards_params_and_cache():
         engine.shutdown()
 
 
+def test_single_chip_placement_honors_assignment():
+    """A tp==1 engine lands on its ASSIGNED chip, not device 0 — two
+    single-chip agents on one host must not stack onto the same chip."""
+    engine = LLMEngine.create("tiny", options={"chips": [3], "max_batch": 2, "max_seq": 128})
+    try:
+        assert engine.tp == 1
+        assert [d.id for d in engine.params["final_norm"].devices()] == [3]
+        assert [d.id for d in engine.cache.k.devices()] == [3]
+
+        async def go():
+            return await engine.generate("placed", max_tokens=4)
+
+        assert asyncio.run(go())["completion_tokens"] == 4
+    finally:
+        engine.shutdown()
+
+
 def test_tp_matches_single_chip_greedy():
     """Greedy decode must produce the same tokens sharded or not (f32 CPU;
     the collectives only change the reduction layout)."""

@@ -1,0 +1,88 @@
+"""Int8 weight-only quantization: scale axes, accuracy, memory, engine path."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agentainer_tpu.engine.quant import param_bytes_actual, quantize_params
+from agentainer_tpu.models.configs import get_config
+from agentainer_tpu.models.llama import forward, init_params
+from agentainer_tpu.ops.quant import QTensor, dequant, quantize_array
+
+
+def test_quantize_array_roundtrip():
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((8, 64, 32)).astype(np.float32) * 0.02
+    qt = quantize_array(w, dtype=jnp.float32)
+    assert qt.q.dtype == jnp.int8
+    assert qt.scale.shape == (8, 1, 32)  # per layer, per output channel
+    back = np.asarray(dequant(qt))
+    # int8 symmetric: worst-case error is scale/2 per element
+    np.testing.assert_allclose(back, w, atol=float(np.abs(w).max()) / 127)
+
+
+def test_quantized_forward_tracks_dense():
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    qparams = quantize_params(
+        jax.tree.map(np.asarray, params), dtype=jnp.float32
+    )
+
+    tokens = jnp.arange(12, dtype=jnp.int32)[None] % cfg.vocab_size
+    positions = jnp.broadcast_to(jnp.arange(12), (1, 12))
+    dense_logits, _ = forward(params, cfg, tokens, positions)
+    q_logits, _ = forward(qparams, cfg, tokens, positions)
+
+    a = np.asarray(dense_logits).reshape(-1, cfg.vocab_size)
+    b = np.asarray(q_logits).reshape(-1, cfg.vocab_size)
+    cos = np.sum(a * b, -1) / (np.linalg.norm(a, axis=-1) * np.linalg.norm(b, axis=-1))
+    assert cos.min() > 0.99, cos.min()
+
+
+def test_quantized_footprint_halves():
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+    dense_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+    qparams = quantize_params(jax.tree.map(np.asarray, params))
+    assert param_bytes_actual(qparams) < 0.62 * dense_bytes
+
+
+def test_engine_serves_quantized():
+    from agentainer_tpu.engine.llm import LLMEngine
+
+    engine = LLMEngine.create(
+        "tiny", options={"quant": "int8", "max_batch": 2, "max_seq": 128}
+    )
+    try:
+        assert isinstance(engine.params["layers"]["wq"], QTensor)
+
+        async def go():
+            return await engine.generate("quantized hello", max_tokens=6)
+
+        result = asyncio.run(go())
+        assert result["completion_tokens"] == 6
+    finally:
+        engine.shutdown()
+
+
+def test_quant_degrades_tp_to_single_chip():
+    """quant=int8 on a multi-chip assignment runs single-chip (extra chips
+    idle, logged) instead of leaving the agent permanently 503."""
+    from agentainer_tpu.engine.llm import LLMEngine
+
+    engine = LLMEngine.create(
+        "tiny",
+        options={"quant": "int8", "tp": 2, "chips": [0, 1], "max_batch": 2, "max_seq": 128},
+    )
+    try:
+        assert engine.tp == 1
+        assert isinstance(engine.params["layers"]["wq"], QTensor)
+
+        async def go():
+            return await engine.generate("hi", max_tokens=4)
+
+        assert asyncio.run(go())["completion_tokens"] == 4
+    finally:
+        engine.shutdown()

@@ -151,6 +151,25 @@ def cmd_list(args) -> None:
 
 
 def cmd_logs(args) -> None:
+    if getattr(args, "follow", False):
+        # stream until interrupted (docker logs -f parity)
+        url = _base(args) + f"/agents/{args.agent_id}/logs?tail={args.tail}&follow=1"
+        with http.get(url, headers=_headers(args), stream=True, timeout=None) as resp:
+            if resp.status_code != 200:
+                print(f"error: {resp.status_code} {resp.text[:200]}", file=sys.stderr)
+                sys.exit(1)
+            try:
+                # bounded chunk size (None buffers until EOF, which a follow
+                # stream never reaches); decode_unicode handles multibyte
+                # UTF-8 straddling chunk boundaries
+                for chunk in resp.iter_content(chunk_size=1024, decode_unicode=True):
+                    sys.stdout.write(
+                        chunk if isinstance(chunk, str) else chunk.decode("utf-8", "replace")
+                    )
+                    sys.stdout.flush()
+            except KeyboardInterrupt:
+                pass
+        return
     doc = _call(args, "GET", f"/agents/{args.agent_id}/logs?tail={args.tail}")
     for line in doc["data"]["logs"]:
         print(line)
@@ -283,6 +302,7 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("logs", help="engine logs")
     s.add_argument("agent_id")
     s.add_argument("--tail", type=int, default=100)
+    s.add_argument("-f", "--follow", action="store_true", help="stream new lines")
     s.set_defaults(fn=cmd_logs)
 
     s = sub.add_parser("invoke", help="send a request through the proxy")

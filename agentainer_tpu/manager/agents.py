@@ -252,6 +252,13 @@ class AgentManager:
             return []
         return self.backend.logs(agent.engine_id, tail=tail)
 
+    def log_path(self, agent_id: str) -> str | None:
+        agent = self.get_agent(agent_id)
+        if not agent.engine_id:
+            return None
+        fn = getattr(self.backend, "log_path", None)
+        return fn(agent.engine_id) if fn else None
+
     # -- helpers for services -------------------------------------------
     def try_get(self, agent_id: str) -> Agent | None:
         try:

@@ -8,11 +8,21 @@ Engine selection stays lazy so the echo engine never imports JAX.
 
 from __future__ import annotations
 
+import logging
 import os
 import sys
 
 
 def main() -> None:
+    # request lines (aiohttp.access) and engine warnings go to stdout, which
+    # the backend captures into the engine's log file — the same visibility
+    # a container gets from docker logs (agent.go:411-429 / logs --follow)
+    logging.basicConfig(
+        level=logging.INFO,
+        stream=sys.stdout,
+        format="%(asctime)s %(name)s %(message)s",
+        force=True,
+    )
     engine = os.environ.get("AGENTAINER_ENGINE", "echo")
     if engine == "echo":
         from ..engine.echo import serve

@@ -297,6 +297,13 @@ class LocalBackend(Backend):
             return []
         return self._tail_log(rec, tail)
 
+    def log_path(self, engine_id: str) -> str | None:
+        """Filesystem path of the engine's log, for follow/streaming reads
+        (agent.go:411-429 GetLogs(follow) parity — the server tails this)."""
+        with self._lock:
+            rec = self._recs.get(engine_id)
+        return None if rec is None else str(rec.log_path)
+
     def _tail_log(self, rec: _EngineRec, tail: int) -> list[str]:
         try:
             with open(rec.log_path, "rb") as f:

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import time
 from typing import TYPE_CHECKING
 
@@ -248,11 +249,62 @@ class ControlPlaneApp:
         self._audit(request, "remove", agent_id, "success")
         return ok(message="Agent removed successfully")
 
-    async def h_logs(self, request: web.Request) -> web.Response:
+    async def h_logs(self, request: web.Request) -> web.StreamResponse:
         agent_id = request.match_info["agent_id"]
         tail = int(request.query.get("tail", "100"))
+        if request.query.get("follow", "").lower() not in ("", "0", "false"):
+            return await self._follow_logs(request, agent_id, tail)
         lines = await self._mgr(self.s.manager.logs, agent_id, tail)
         return ok({"logs": lines})
+
+    async def _follow_logs(
+        self, request: web.Request, agent_id: str, tail: int
+    ) -> web.StreamResponse:
+        """Stream engine log lines until the client disconnects
+        (agent.go:411-429 GetLogs(follow) / docker logs -f parity)."""
+        path = await self._mgr(self.s.manager.log_path, agent_id)
+        resp = web.StreamResponse(
+            headers={"Content-Type": "text/plain; charset=utf-8"}
+        )
+        await resp.prepare(request)
+        # offset BEFORE the tail snapshot: lines appended in between are then
+        # re-sent rather than silently dropped (docker-logs behavior)
+        offset = 0
+        if path:
+            try:
+                offset = os.path.getsize(path)
+            except OSError:
+                pass
+        for line in await self._mgr(self.s.manager.logs, agent_id, tail):
+            await resp.write(line.encode() + b"\n")
+        try:
+            while True:
+                if not path:
+                    await asyncio.sleep(0.5)
+                    # agent may not have an engine yet (created/stopped);
+                    # removal mid-follow ends the stream cleanly
+                    path = await self._mgr(self.s.manager.log_path, agent_id)
+                    continue
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    await asyncio.sleep(0.5)
+                    continue
+                if size < offset:
+                    offset = 0  # rotated/truncated: restart from the top
+                if size > offset:
+                    with open(path, "rb") as f:
+                        f.seek(offset)
+                        chunk = f.read(min(size - offset, 1 << 20))
+                    offset += len(chunk)
+                    await resp.write(chunk)
+                else:
+                    await asyncio.sleep(0.5)  # idle only when caught up
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        except Exception:
+            pass  # agent removed / backend error: close the stream cleanly
+        return resp
 
     async def h_requests(self, request: web.Request) -> web.Response:
         agent_id = request.match_info["agent_id"]

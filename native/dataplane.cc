@@ -5,6 +5,7 @@
 #include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <sys/un.h>
 #include <unistd.h>
@@ -781,6 +782,64 @@ void DataPlane::handle_conn(int fd) {
     std::string fwd = build_upstream_request(
         req.method, req.target, req.headers, req.body,
         backend_host_ + ":" + std::to_string(backend_port_), "", /*strip_auth=*/false);
+
+    // log-follow responses never end: relay bytes as they arrive instead of
+    // buffering the (unbounded) body through roundtrip(). Dedicated upstream
+    // connection; both sockets close when either side goes away.
+    // Match the Python handler's semantics: follow present and not 0/false.
+    bool follow_stream = false;
+    if (req.target.find("/logs") != std::string::npos) {
+      size_t fpos = req.target.find("follow=");
+      if (fpos != std::string::npos) {
+        std::string val = req.target.substr(fpos + 7);
+        size_t amp = val.find('&');
+        if (amp != std::string::npos) val = val.substr(0, amp);
+        follow_stream = !val.empty() && val != "0" && lower(val) != "false";
+      }
+    }
+    if (follow_stream) {
+      bool refused = false;
+      int ufd = ctx.connect_to(backend_host_, backend_port_, &refused);
+      if (ufd < 0 || !send_all(ufd, fwd)) {
+        if (ufd >= 0) {
+          track(ufd, false);
+          ::close(ufd);
+        }
+        resp_raw = build_response(
+            502, {}, envelope(false, "management backend unavailable", ""), false);
+        send_all(fd, resp_raw);
+        break;
+      }
+      // follow streams idle between log lines: poll BOTH sockets so an
+      // upstream line relays promptly AND a client disconnect during an
+      // idle stream tears the relay down (no leaked thread/fds)
+      char buf[1 << 14];
+      for (;;) {
+        pollfd fds[2];
+        fds[0] = {ufd, POLLIN, 0};
+        fds[1] = {fd, POLLIN | POLLRDHUP, 0};
+        int pr = ::poll(fds, 2, 1000);
+        if (pr < 0) break;
+        if (pr == 0) {
+          if (stopping_.load()) break;
+          continue;
+        }
+        if (fds[1].revents) {
+          // bytes from the client mid-stream or HUP: either way, done —
+          // a follow response accepts no further requests on this conn
+          break;
+        }
+        if (fds[0].revents) {
+          ssize_t n = ::recv(ufd, buf, sizeof(buf), 0);
+          if (n <= 0) break;
+          if (!send_all(fd, buf, static_cast<size_t>(n))) break;
+        }
+      }
+      track(ufd, false);
+      ::close(ufd);
+      break;  // stream consumed the connection
+    }
+
     HttpMsg up;
     int rc = ctx.roundtrip(backend_host_, backend_port_, fwd, &up,
                            req.method == "HEAD");

@@ -208,3 +208,52 @@ def test_pause_resume_signals(tmp_path):
             await teardown(services, client)
 
     run(body())
+
+
+def test_logs_follow_streams_new_lines(tmp_path):
+    """GET /agents/{id}/logs?follow=1 streams the tail and then NEW engine
+    output as it appears (GetLogs(follow) / docker logs -f parity)."""
+
+    async def body():
+        services, client = await start_stack(tmp_path)
+        try:
+            resp = await client.post(
+                "/agents", json={"name": "echo-f", "model": "echo"}, headers=AUTH
+            )
+            agent = (await resp.json())["data"]
+            await client.post(f"/agents/{agent['id']}/start", headers=AUTH)
+            await client.post(
+                f"/agent/{agent['id']}/chat", data=json.dumps({"message": "one"})
+            )
+
+            resp = await client.get(
+                f"/agents/{agent['id']}/logs", params={"follow": "1"}, headers=AUTH
+            )
+            assert resp.status == 200
+            # initial tail arrives
+            first = await asyncio.wait_for(resp.content.read(64), timeout=5)
+            assert first
+
+            # new engine activity shows up on the open stream
+            await client.post(
+                f"/agent/{agent['id']}/chat",
+                data=json.dumps({"message": "follow-marker"}),
+            )
+            more = b""
+            deadline = asyncio.get_event_loop().time() + 8
+            while asyncio.get_event_loop().time() < deadline:
+                try:
+                    chunk = await asyncio.wait_for(resp.content.read(4096), timeout=2)
+                except asyncio.TimeoutError:
+                    continue
+                if not chunk:
+                    break
+                more += chunk
+                if b"chat" in more or b"POST" in more:
+                    break
+            assert more, "no new log lines streamed after follow started"
+            resp.close()
+        finally:
+            await teardown(services, client)
+
+    run(body())

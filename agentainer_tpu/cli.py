@@ -228,14 +228,21 @@ def cmd_backup(args) -> None:
     elif args.backup_cmd == "export":
         # the server streams the tar.gz; the archive lands on THIS machine
         url = _base(args) + f"/backups/{args.backup_id}/export"
-        resp = http.request("POST", url, headers=_headers(args), timeout=120)
-        if resp.headers.get("Content-Type", "").startswith("application/json"):
-            doc = resp.json()
-            print(f"error: {doc.get('message', resp.status_code)}", file=sys.stderr)
+        # stream: archives carry checkpoints/KV snapshots and can be large
+        resp = http.request("POST", url, headers=_headers(args), timeout=120, stream=True)
+        if resp.status_code != 200 or resp.headers.get("Content-Type", "").startswith(
+            "application/json"
+        ):
+            try:
+                msg = resp.json().get("message", resp.status_code)
+            except ValueError:
+                msg = resp.status_code
+            print(f"error: {msg}", file=sys.stderr)
             sys.exit(1)
         out = args.output or f"{args.backup_id}.tar.gz"
         with open(out, "wb") as f:
-            f.write(resp.content)
+            for chunk in resp.iter_content(1 << 20):
+                f.write(chunk)
         print(f"exported to {out}")
 
 

@@ -124,10 +124,12 @@ class LLMEngine:
             from jax.sharding import NamedSharding
 
             from ..parallel.mesh import make_mesh
-            from ..parallel.sharding import cache_specs, param_shardings
+            from ..parallel.sharding import cache_specs, param_shardings_for
 
             self.mesh = make_mesh(self.tp, tp=self.tp, devices=devices)
-            params = jax.device_put(params, param_shardings(self.mesh, cfg.is_moe))
+            # quant-aware: int8 QTensor leaves shard q on the dense spec and
+            # replicate the scale across the contraction split
+            params = jax.device_put(params, param_shardings_for(params, self.mesh, cfg.is_moe))
             cache_sh = NamedSharding(self.mesh, cache_specs())
             cache = jax.jit(
                 lambda: KVCache(
@@ -181,7 +183,25 @@ class LLMEngine:
         options: dict | None = None,
     ) -> "LLMEngine":
         options = options or {}
-        cfg = get_config(config_name or "tiny")
+        # HF checkpoints carry their own config.json — derive the config
+        # from the checkpoint itself so a mistyped/missing config name can't
+        # cause an opaque shape error deep in the loader (ADVICE round-1)
+        from .hf_convert import config_from_hf, is_hf_checkpoint
+
+        if checkpoint and is_hf_checkpoint(checkpoint):
+            try:
+                cfg = config_from_hf(checkpoint)
+            except (OSError, KeyError, ValueError) as e:
+                # converted weights without a (llama-style) config.json: an
+                # explicit config name remains authoritative
+                if not config_name:
+                    raise ValueError(
+                        f"checkpoint {checkpoint!r} has no usable config.json "
+                        f"({e!r}); pass model.config explicitly"
+                    ) from e
+                cfg = get_config(config_name)
+        else:
+            cfg = get_config(config_name or "tiny")
         tokenizer = load_tokenizer(cfg.vocab_size, checkpoint)
         dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
         quant = str(options.get("quant", "") or "").lower()
@@ -191,23 +211,23 @@ class LLMEngine:
         # serve-time TP: the control plane passes the agent's assigned chip
         # ids (llm_serve); clamp to the visible devices and to a divisor of
         # the model's head counts. Standalone default is single-chip.
-        # quant=int8's pytree doesn't match the TP sharding specs, so it
-        # degrades to one chip the same way non-dividing head counts do.
+        # int8 quant keeps TP: the QTensor pytree gets matching shardings
+        # (parallel/sharding.param_shardings_for).
         from ..parallel.mesh import pick_tp
 
         all_devices = jax.devices()
         chips = [int(c) for c in options.get("chips", []) or []]
-        tp_req = max(1, int(options.get("tp", 0) or len(chips) or 1))
+        tp_asked = max(1, int(options.get("tp", 0) or len(chips) or 1))
+        # an explicit chip assignment is the placement authority: tp may only
+        # narrow the span, never spill onto chips owned by other agents
+        tp_req = min(tp_asked, len(chips)) if chips else tp_asked
         tp = pick_tp(cfg, min(tp_req, len(all_devices)))
-        if quant:
-            tp = 1
-        if tp != tp_req:
+        if tp != tp_asked:
             print(
-                f"[llm-engine] tp degraded {tp_req} -> {tp} "
-                f"(visible devices={len(all_devices)}, model kv_heads="
-                f"{cfg.n_kv_heads}, heads={cfg.n_heads}"
-                + (", quant=int8 is single-chip" if quant else "")
-                + "); extra chips idle",
+                f"[llm-engine] tp degraded {tp_asked} -> {tp} "
+                f"(assigned chips={len(chips) or 'all'}, visible devices="
+                f"{len(all_devices)}, model kv_heads={cfg.n_kv_heads}, "
+                f"heads={cfg.n_heads}); extra chips idle",
                 flush=True,
             )
         # the mesh spans the ASSIGNED chips when their ids map to visible

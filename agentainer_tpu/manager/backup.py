@@ -18,6 +18,7 @@ import base64
 import json
 import tarfile
 import time
+import uuid
 from pathlib import Path
 
 from ..core.errors import InvalidInput
@@ -139,10 +140,23 @@ class BackupManager:
             raise InvalidInput(f"backup not found: {backup_id}")
         exports = self.dir / "exports"
         exports.mkdir(parents=True, exist_ok=True)
+        # sweep artifacts abandoned by cancelled/disconnected exports (the
+        # HTTP layer deletes its own after streaming; anything older than an
+        # hour was orphaned) so the directory cannot grow without bound
+        cutoff = time.time() - 3600
+        for stale in exports.glob("*.tar.gz"):
+            try:
+                if stale.stat().st_mtime < cutoff:
+                    stale.unlink()
+            except OSError:
+                pass
         name = Path(str(out_path)).name if out_path else f"{backup_id}.tar.gz"
         if not name.endswith(".tar.gz"):
             name += ".tar.gz"
-        out = exports / name
+        # unique artifact per export: a concurrent re-export of the same
+        # backup must never rewrite a file another response is still
+        # streaming; the HTTP layer deletes it after the stream ends
+        out = exports / f"{uuid.uuid4().hex}-{name}"
         with tarfile.open(out, "w:gz") as tar:
             tar.add(path, arcname=path.name)
         return out

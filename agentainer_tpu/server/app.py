@@ -79,6 +79,37 @@ _HOP_BY_HOP = {
 }
 
 
+def _tail_snapshot(path: str, tail: int) -> tuple[list[bytes], int]:
+    """Last ``tail`` complete lines of ``path`` plus the follow offset.
+
+    One consistent snapshot: lines and offset come from the same read, so
+    the follow loop resumes exactly after the last line served. A trailing
+    partial line (a write in flight) is NOT returned; the offset rewinds to
+    its start so it streams whole once complete. Splits on ``\\n`` only —
+    CR-progress lines (tqdm-style) are content, not terminators. Reads a
+    bounded window from the end, growing only if it holds too few lines.
+    """
+    size = os.path.getsize(path)
+    window = 256 << 10
+    with open(path, "rb") as f:
+        while True:
+            start = max(0, size - window)
+            f.seek(start)
+            data = f.read(size - start)
+            lines = data.split(b"\n")
+            if data.endswith(b"\n"):
+                lines.pop()  # split's trailing empty piece
+                offset = start + len(data)
+            else:
+                partial = lines.pop()
+                offset = start + len(data) - len(partial)
+            if start > 0:
+                lines = lines[1:]  # first piece may be a mid-line fragment
+            if start == 0 or len(lines) >= tail:
+                return (lines[-tail:] if tail > 0 else []), offset
+            window *= 4
+
+
 def envelope(data=None, message: str = "", success: bool = True) -> dict:
     return {"success": success, "message": message, "data": data}
 
@@ -267,16 +298,22 @@ class ControlPlaneApp:
             headers={"Content-Type": "text/plain; charset=utf-8"}
         )
         await resp.prepare(request)
-        # offset BEFORE the tail snapshot: lines appended in between are then
-        # re-sent rather than silently dropped (docker-logs behavior)
+        # exactly-once: snapshot the size first and serve the tail from the
+        # SAME read, capped at that offset — lines appended concurrently are
+        # picked up by the follow loop only, never sent twice. A trailing
+        # partial line is excluded and the offset rewound past it, so the
+        # follow loop later delivers it whole, never split mid-write.
         offset = 0
         if path:
             try:
-                offset = os.path.getsize(path)
+                lines, offset = await asyncio.to_thread(_tail_snapshot, path, tail)
+                for line in lines:
+                    await resp.write(line + b"\n")
             except OSError:
                 pass
-        for line in await self._mgr(self.s.manager.logs, agent_id, tail):
-            await resp.write(line.encode() + b"\n")
+        else:
+            for line in await self._mgr(self.s.manager.logs, agent_id, tail):
+                await resp.write(line.encode() + b"\n")
         try:
             while True:
                 if not path:
@@ -526,14 +563,27 @@ class ControlPlaneApp:
         server-side path."""
         backup_id = request.match_info["backup_id"]
         exported = await self._mgr(self.s.backups.export, backup_id)
-        self._audit(request, "backup-export", backup_id, "success")
-        return web.FileResponse(
-            exported,
-            headers={
-                "Content-Type": "application/gzip",
-                "Content-Disposition": f'attachment; filename="{exported.name}"',
-            },
-        )
+        try:
+            self._audit(request, "backup-export", backup_id, "success")
+            # stream in chunks off the event loop and delete the one-shot
+            # artifact afterwards — exports must not accumulate on disk
+            # (abandoned artifacts from cancelled exports are swept by
+            # BackupManager.export itself)
+            resp = web.StreamResponse(
+                headers={
+                    "Content-Type": "application/gzip",
+                    "Content-Disposition": f'attachment; filename="{backup_id}.tar.gz"',
+                    "Content-Length": str(exported.stat().st_size),
+                }
+            )
+            await resp.prepare(request)
+            with exported.open("rb") as f:
+                while chunk := await asyncio.to_thread(f.read, 1 << 20):
+                    await resp.write(chunk)
+            await resp.write_eof()
+        finally:
+            exported.unlink(missing_ok=True)
+        return resp
 
     async def h_backup_delete(self, request: web.Request) -> web.Response:
         backup_id = request.match_info["backup_id"]

@@ -67,9 +67,10 @@ def test_engine_serves_quantized():
         engine.shutdown()
 
 
-def test_quant_degrades_tp_to_single_chip():
-    """quant=int8 on a multi-chip assignment runs single-chip (extra chips
-    idle, logged) instead of leaving the agent permanently 503."""
+def test_quant_keeps_tp():
+    """quant=int8 + tp=2: the QTensor pytree shards (q on the dense spec,
+    scale replicated across the contraction split) instead of degrading to
+    one chip — required for multi-chip 8B serving (VERDICT round-1 item 2)."""
     from agentainer_tpu.engine.llm import LLMEngine
 
     engine = LLMEngine.create(
@@ -77,12 +78,56 @@ def test_quant_degrades_tp_to_single_chip():
         options={"quant": "int8", "tp": 2, "chips": [0, 1], "max_batch": 2, "max_seq": 128},
     )
     try:
-        assert engine.tp == 1
-        assert isinstance(engine.params["layers"]["wq"], QTensor)
+        assert engine.tp == 2
+        wq = engine.params["layers"]["wq"]
+        assert isinstance(wq, QTensor)
+        assert len(wq.q.sharding.device_set) == 2
+        # row-parallel wo splits its contraction axis; the scale must not
+        assert len(engine.params["layers"]["wo"].q.sharding.device_set) == 2
 
         async def go():
             return await engine.generate("hi", max_tokens=4)
 
         assert asyncio.run(go())["completion_tokens"] == 4
+    finally:
+        engine.shutdown()
+
+
+def test_quant_tp_matches_quant_single_chip():
+    """Greedy tokens identical between quant tp=1 and quant tp=2 (f32 CPU):
+    sharding only changes the reduction layout, not the math."""
+    from agentainer_tpu.engine.llm import LLMEngine
+
+    def mk(tp):
+        return LLMEngine.create(
+            "tiny", options={"quant": "int8", "tp": tp, "max_batch": 2, "max_seq": 128}
+        )
+
+    e1, e2 = mk(1), mk(2)
+    try:
+
+        async def go(e):
+            return await e.generate("the quick brown fox", max_tokens=6)
+
+        r1 = asyncio.run(go(e1))
+        r2 = asyncio.run(go(e2))
+        assert r1["tokens"] == r2["tokens"], (r1["tokens"], r2["tokens"])
+    finally:
+        e1.shutdown()
+        e2.shutdown()
+
+
+def test_tp_clamps_to_assigned_chips():
+    """options.tp beyond the scheduler's chip assignment must NOT spill onto
+    other agents' chips (ADVICE round-1 medium): tp narrows to the span."""
+    from agentainer_tpu.engine.llm import LLMEngine
+
+    engine = LLMEngine.create(
+        "tiny", options={"tp": 4, "chips": [2, 3], "max_batch": 2, "max_seq": 128}
+    )
+    try:
+        assert engine.tp == 2
+        used = {d.id for d in engine.cache.k.sharding.device_set}
+        assert used == {2, 3}, used
     finally:
         engine.shutdown()

@@ -485,3 +485,33 @@ def test_requests_unknown_status_400(tmp_path):
         await client.close()
 
     run(body())
+
+
+def test_tail_snapshot_exactly_once(tmp_path):
+    """Log-follow tail snapshot: complete lines only, offset resumes after
+    the last served byte, CR is line content (not a terminator), and a
+    trailing partial line is deferred to the follow loop — never split."""
+    from agentainer_tpu.server.app import _tail_snapshot
+
+    p = tmp_path / "engine.log"
+    p.write_bytes(b"one\ntwo\nepoch 3/10\r")
+    lines, offset = _tail_snapshot(str(p), tail=10)
+    assert lines == [b"one", b"two"]
+    assert offset == len(b"one\ntwo\n")  # partial CR line deferred, whole
+
+    p.write_bytes(b"a\nb\nc\n")
+    lines, offset = _tail_snapshot(str(p), tail=2)
+    assert lines == [b"b", b"c"]
+    assert offset == 6
+
+    lines, offset = _tail_snapshot(str(p), tail=0)
+    assert lines == []
+    assert offset == 6
+
+    # window growth: more lines than the initial 256K window holds
+    big = b"".join(b"line %06d padded %s\n" % (i, b"x" * 120) for i in range(4000))
+    p.write_bytes(big)
+    lines, offset = _tail_snapshot(str(p), tail=3000)
+    assert len(lines) == 3000
+    assert lines[-1].startswith(b"line 003999")
+    assert offset == len(big)

@@ -81,6 +81,10 @@ class Slot:
     session: str = ""
     position: int = 0  # next cache position to write
     request: GenRequest | None = None
+    # prompt tokens not yet prefilled: chunked prefill feeds these through
+    # the model a chunk at a time, interleaved with decode steps, so one
+    # long prompt can't stall every active generation's ITL
+    pending_prompt: list[int] = field(default_factory=list)
     last_used: float = 0.0
     # the final sampled token of the previous reply was never fed through the
     # model; it is prepended to the session's next prompt so the KV context
@@ -100,37 +104,57 @@ class LLMEngine:
         max_batch: int,
         max_seq: int,
         decode_chunk: int = 8,
+        prefill_chunk: int = 256,
         tp: int = 1,
+        ep: int = 1,
+        sp: int = 1,
         devices: list | None = None,
     ):
         self.cfg = cfg
         self.tokenizer = tokenizer
         self.max_batch = max_batch
+        self.sp = max(1, sp)
+        # the sequence axis must split evenly over sp chips
+        max_seq = ((max_seq + self.sp - 1) // self.sp) * self.sp
         self.max_seq = max_seq
         self.decode_chunk = max(1, decode_chunk)
+        # snap DOWN to a bucket: a non-bucket chunk size would pad every
+        # non-final chunk up to the next bucket (wasted prefill compute)
+        clamped = min(max(PREFILL_BUCKETS[0], prefill_chunk), PREFILL_BUCKETS[-1])
+        self.prefill_chunk = max(b for b in PREFILL_BUCKETS if b <= clamped)
         self.tp = max(1, tp)
+        self.ep = max(1, ep)
         self.scratch_pos = max_seq - 1  # idle-slot write target; never generated into
         dtype = params["final_norm"].dtype  # always dense, even when quantized
         cache_shape = (cfg.n_layers, max_batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
-        if self.tp > 1:
-            # serve-time tensor parallelism: Megatron-style GSPMD shardings
-            # over a 1×tp mesh on the agent's ASSIGNED chips — heads/FFN
-            # width split across them, KV arena split on the kv-head axis;
-            # XLA inserts the ICI collectives. (DP scale-out stays at the
-            # control plane via `replicas: N`, matching the reference's
-            # fan-out.) Params arrive host-side and are device_put directly
-            # with their shardings, and the arena is allocated sharded, so
-            # nothing is ever materialized whole on one chip.
+        if self.tp * self.ep * self.sp > 1:
+            # serve-time model parallelism over the agent's ASSIGNED chips:
+            # Megatron-style GSPMD shardings on a tp×ep mesh — heads/FFN
+            # width split over tp, MoE expert weights split over ep (each
+            # chip holds and computes E/ep experts; the top-k combine's
+            # expert contraction becomes a psum — BASELINE config #5), KV
+            # arena split on the kv-head axis; XLA inserts the ICI
+            # collectives. (DP scale-out stays at the control plane via
+            # `replicas: N`, matching the reference's fan-out.) Params
+            # arrive host-side and are device_put directly with their
+            # shardings, and the arena is allocated sharded, so nothing is
+            # ever materialized whole on one chip.
             from jax.sharding import NamedSharding
 
             from ..parallel.mesh import make_mesh
             from ..parallel.sharding import cache_specs, param_shardings_for
 
-            self.mesh = make_mesh(self.tp, tp=self.tp, devices=devices)
+            self.mesh = make_mesh(
+                self.tp * self.ep * self.sp,
+                tp=self.tp,
+                sp=self.sp,
+                ep=self.ep,
+                devices=devices,
+            )
             # quant-aware: int8 QTensor leaves shard q on the dense spec and
             # replicate the scale across the contraction split
             params = jax.device_put(params, param_shardings_for(params, self.mesh, cfg.is_moe))
-            cache_sh = NamedSharding(self.mesh, cache_specs())
+            cache_sh = NamedSharding(self.mesh, cache_specs(sp=self.sp > 1))
             cache = jax.jit(
                 lambda: KVCache(
                     jnp.zeros(cache_shape, dtype), jnp.zeros(cache_shape, dtype)
@@ -164,8 +188,10 @@ class LLMEngine:
         self.tokens_generated = 0
         self.prefills = 0
         self.ttft_ms_recent: collections.deque[float] = collections.deque(maxlen=256)
+        self.itl_ms_recent: collections.deque[float] = collections.deque(maxlen=256)
         self.decode_steps = 0
         self._occupancy_sum = 0.0
+        self._last_decode_end: float | None = None
         self._started_at = time.monotonic()
 
         self._build_compiled()
@@ -213,30 +239,67 @@ class LLMEngine:
         # the model's head counts. Standalone default is single-chip.
         # int8 quant keeps TP: the QTensor pytree gets matching shardings
         # (parallel/sharding.param_shardings_for).
-        from ..parallel.mesh import pick_tp
+        from ..parallel.mesh import pick_ep, pick_tp
 
         all_devices = jax.devices()
         chips = [int(c) for c in options.get("chips", []) or []]
-        tp_asked = max(1, int(options.get("tp", 0) or len(chips) or 1))
-        # an explicit chip assignment is the placement authority: tp may only
-        # narrow the span, never spill onto chips owned by other agents
-        tp_req = min(tp_asked, len(chips)) if chips else tp_asked
-        tp = pick_tp(cfg, min(tp_req, len(all_devices)))
-        if tp != tp_asked:
+        tp_asked = int(options.get("tp", 0) or 0)
+        ep_asked = int(options.get("ep", 0) or 0)
+        sp_asked = int(options.get("sp", 0) or 0)
+        # chip budget: an explicit chip assignment is the placement
+        # authority — tp×sp×ep may only narrow the span, never spill onto
+        # chips owned by other agents; standalone (no assignment) spans
+        # exactly what the options ask for
+        if chips:
+            budget = min(len(chips), len(all_devices))
+        else:
+            budget = min(
+                len(all_devices),
+                max(1, tp_asked) * max(1, ep_asked) * max(1, sp_asked),
+            )
+        # sequence parallelism is opt-in (long-context serving); requested
+        # sp reserves its chips before the tp/ep split
+        model_budget = max(1, budget // max(1, sp_asked))
+        if cfg.is_moe:
+            # EP-first: experts dominate a MoE model's HBM footprint, and
+            # "Mixtral across the slice via EP" is the flagship scale-out
+            # config. Explicit tp/ep options override the split.
+            if ep_asked:
+                ep = pick_ep(cfg, min(ep_asked, model_budget))
+                tp = pick_tp(cfg, min(max(1, tp_asked), model_budget // ep))
+            elif tp_asked:
+                tp = pick_tp(cfg, min(tp_asked, model_budget))
+                ep = pick_ep(cfg, model_budget // tp)
+            else:
+                ep = pick_ep(cfg, model_budget)
+                tp = pick_tp(cfg, model_budget // ep)
+        else:
+            ep = 1
+            # dense + assigned chips + no explicit tp: span the whole
+            # assignment (the scheduler sized it; idle chips help nobody)
+            dense_tp = tp_asked if tp_asked else (model_budget if chips else 1)
+            tp = pick_tp(cfg, min(max(1, dense_tp), model_budget))
+        sp = max(1, min(sp_asked, budget // (tp * ep))) if sp_asked else 1
+        n_use = tp * ep * sp
+        asked = max(1, tp_asked) * max(1, ep_asked) * max(1, sp_asked)
+        if n_use < min(asked, budget) or (chips and n_use < len(chips)):
             print(
-                f"[llm-engine] tp degraded {tp_asked} -> {tp} "
-                f"(assigned chips={len(chips) or 'all'}, visible devices="
+                f"[llm-engine] parallelism narrowed to tp={tp} ep={ep} sp={sp} "
+                f"(asked tp={tp_asked or 'auto'} ep={ep_asked or 'auto'} "
+                f"sp={sp_asked or 'auto'}, "
+                f"assigned chips={len(chips) or 'none'}, visible devices="
                 f"{len(all_devices)}, model kv_heads={cfg.n_kv_heads}, "
-                f"heads={cfg.n_heads}); extra chips idle",
+                f"heads={cfg.n_heads}, experts={cfg.n_experts}); "
+                "extra chips idle",
                 flush=True,
             )
         # the mesh spans the ASSIGNED chips when their ids map to visible
         # devices (multi-chip host); engines on a tunneled/virtual platform
-        # fall back to the first tp devices
-        if chips and len(chips) >= tp and all(c < len(all_devices) for c in chips):
-            devices = [all_devices[c] for c in chips[:tp]]
+        # fall back to the first tp*ep devices
+        if chips and len(chips) >= n_use and all(c < len(all_devices) for c in chips):
+            devices = [all_devices[c] for c in chips[:n_use]]
         else:
-            devices = list(all_devices[:tp])
+            devices = list(all_devices[:n_use])
 
         if checkpoint:
             from .checkpoint import load_params
@@ -262,8 +325,11 @@ class LLMEngine:
             # host-side: only the int8 model ever reaches HBM
             params = quantize_params(params, dtype)
         max_batch = int(options.get("max_batch", 8))
-        max_seq = int(options.get("max_seq", min(cfg.max_seq_len, 2048)))
+        # long-context default scales with sp: the sharded arena holds
+        # sp× one chip's context budget (explicit max_seq still wins)
+        max_seq = int(options.get("max_seq", min(cfg.max_seq_len, 2048 * sp)))
         decode_chunk = int(options.get("decode_chunk", 8))
+        prefill_chunk = int(options.get("prefill_chunk", 256))
         engine = cls(
             cfg,
             params,
@@ -271,7 +337,10 @@ class LLMEngine:
             max_batch=max_batch,
             max_seq=max_seq,
             decode_chunk=decode_chunk,
+            prefill_chunk=prefill_chunk,
             tp=tp,
+            ep=ep,
+            sp=sp,
             devices=devices,
         )
         # pay the decode/prefill compiles here (inside the loader thread, while
@@ -282,8 +351,8 @@ class LLMEngine:
     def _build_compiled(self) -> None:
         cfg = self.cfg
         # GSPMD cannot auto-partition a pallas_call: the Pallas kernels serve
-        # the single-chip path; TP shards the einsum path on the head axis
-        use_flash = self.tp == 1
+        # the single-chip path; meshed engines (tp/ep) use the einsum path
+        use_flash = self.mesh is None
 
         def prefill(params, cache, slot, tokens, positions, n_real):
             # slice the slot's cache row, run the prompt, write the row back
@@ -440,6 +509,7 @@ class LLMEngine:
     def metrics(self) -> dict:
         elapsed = max(1e-6, time.monotonic() - self._started_at)
         recent = sorted(self.ttft_ms_recent)
+        itl = sorted(self.itl_ms_recent)
         return {
             "tokens_generated": self.tokens_generated,
             "tokens_per_s": round(self.tokens_generated / elapsed, 2),
@@ -447,10 +517,13 @@ class LLMEngine:
             "decode_steps": self.decode_steps,
             "batch_occupancy": round(self._occupancy_sum / max(1, self.decode_steps), 3),
             "ttft_ms_p50": round(recent[len(recent) // 2], 2) if recent else None,
+            "itl_ms_p50": round(itl[len(itl) // 2], 2) if itl else None,
             "active_sessions": len(self.sessions),
             "max_batch": self.max_batch,
             "max_seq": self.max_seq,
             "tp": self.tp,
+            "ep": self.ep,
+            "sp": self.sp,
         }
 
     def shutdown(self) -> None:
@@ -491,14 +564,22 @@ class LLMEngine:
                     self._fail_item(item, e)
             waiting = still
             try:
-                if any(s.request is not None for s in self.slots):
+                # ONE prefill chunk, then a decode step: a long prompt is
+                # fed through chunk-by-chunk between decode steps, so
+                # admitting it never stalls active generations for more
+                # than one chunk's latency
+                self._prefill_tick()
+                if any(s.request is not None and not s.pending_prompt for s in self.slots):
                     self._decode_step()
+                else:
+                    self._last_decode_end = None  # idle gap isn't ITL
             except Exception as e:
                 # fail every in-flight request rather than hanging them
                 for slot in self.slots:
                     if slot.request is not None:
                         self._fail_item(slot.request, e)
                         slot.request = None
+                        slot.pending_prompt = []
             if not any(s.request is not None for s in self.slots) and waiting:
                 time.sleep(0.002)  # all slots busy-by-session; brief backoff
 
@@ -539,7 +620,11 @@ class LLMEngine:
             slot.epoch += 1
         if len(prompt) > budget:
             prompt = prompt[-budget:]  # keep the tail
-        self._run_prefill(slot, req, prompt)
+        # admit: the slot is busy from here; the worker's prefill tick feeds
+        # the prompt through chunk-by-chunk, interleaved with decode steps
+        slot.request = req
+        slot.pending_prompt = prompt
+        slot.last_used = time.monotonic()
         return True
 
     def _find_slot(self, session: str) -> Slot | None:
@@ -559,6 +644,7 @@ class LLMEngine:
         slot.session = session
         slot.position = 0
         slot.pending_token = None  # stale state from the previous occupant
+        slot.pending_prompt = []
         slot.epoch += 1
         if session:
             self.sessions[session] = slot.idx
@@ -570,28 +656,42 @@ class LLMEngine:
                 return b
         return PREFILL_BUCKETS[-1]
 
-    def _run_prefill(self, slot: Slot, req: GenRequest, prompt: list[int]) -> None:
-        n = len(prompt)
+    def _prefill_tick(self) -> None:
+        """Feed ONE chunk of one pending prompt through the model (FIFO by
+        submission time). Non-final chunks only populate the slot's KV; the
+        final chunk samples the first token. Interleaving these ticks with
+        decode steps bounds how long one long prompt can stall every active
+        generation: one chunk's latency, not the whole prompt's."""
+        slots = [s for s in self.slots if s.request is not None and s.pending_prompt]
+        if not slots:
+            return
+        slot = min(slots, key=lambda s: s.request.submitted_at)
+        req = slot.request
+        chunk = slot.pending_prompt[: self.prefill_chunk]
+        slot.pending_prompt = slot.pending_prompt[self.prefill_chunk :]
+        final = not slot.pending_prompt
+        n = len(chunk)
         bucket = self._bucket(n)
-        padded = prompt + [0] * (bucket - n)
+        padded = chunk + [0] * (bucket - n)
         # padding positions continue past the real tokens; every such slot is
-        # rewritten by the real token that later occupies it before any query
-        # can attend to it (decode is sequential), so no garbage is visible
+        # rewritten by a later real token (next chunk or decode) before any
+        # query can attend to it, and the position mask hides the rest
         positions = np.arange(slot.position, slot.position + bucket, dtype=np.int32)
         tokens = jnp.asarray(np.array(padded, dtype=np.int32)[None])
         pos = jnp.asarray(positions[None])
         last_logits, self.cache = self._prefill(
             self.params, self.cache, jnp.int32(slot.idx), tokens, pos, jnp.int32(n)
         )
+        slot.position += n
+        slot.last_used = time.monotonic()
+        if not final:
+            return
         self._rng, key = jax.random.split(self._rng)
         first = sample(last_logits[None], key, temperature=jnp.asarray([req.temperature]))
         first_id = int(first[0])
         req.ttft_ms = 1000 * (time.monotonic() - req.submitted_at)
         self.ttft_ms_recent.append(req.ttft_ms)
         self.prefills += 1
-        slot.position += n
-        slot.request = req
-        slot.last_used = time.monotonic()
         self._append_token(slot, first_id)
 
     def _append_token(self, slot: Slot, token_id: int) -> None:
@@ -627,7 +727,7 @@ class LLMEngine:
         temps = np.zeros((self.max_batch,), np.float32)
         active: list[Slot] = []
         for slot in self.slots:
-            if slot.request is not None:
+            if slot.request is not None and not slot.pending_prompt:
                 tokens[slot.idx] = slot.request.generated[-1]
                 positions[slot.idx] = slot.position
                 temps[slot.idx] = slot.request.temperature
@@ -647,6 +747,12 @@ class LLMEngine:
         toks = np.asarray(toks)  # [chunk, B]
         self.decode_steps += 1
         self._occupancy_sum += len(active) / self.max_batch
+        # ITL = wall time between consecutive decode steps (including any
+        # interleaved prefill chunk) per generated token
+        end = time.monotonic()
+        if self._last_decode_end is not None:
+            self.itl_ms_recent.append(1000 * (end - self._last_decode_end) / chunk)
+        self._last_decode_end = end
         eos = self.tokenizer.eos_id
         for slot in active:
             req = slot.request

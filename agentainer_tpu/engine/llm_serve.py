@@ -76,7 +76,9 @@ class LLMServeApp:
     def _engine_options(self) -> dict:
         opts = dict(self.model_options)
         if self.chips:
-            opts.setdefault("tp", len(self.chips))
+            # no tp injection: LLMEngine.create derives the parallelism
+            # split from the chip budget itself (dense → tp-first, MoE →
+            # ep-first), and an explicit options.tp/ep/sp only narrows it
             opts["chips"] = list(self.chips)
         return opts
 

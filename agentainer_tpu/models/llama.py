@@ -123,6 +123,7 @@ def _attention_block(
     ck: jnp.ndarray | None,
     cv: jnp.ndarray | None,
     use_flash: bool,
+    attn_impl=None,
 ):
     b, t, d = x.shape
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
@@ -138,6 +139,11 @@ def _attention_block(
         ck = ck.at[batch_idx, positions].set(k)
         cv = cv.at[batch_idx, positions].set(v)
         attn = cache_attention(q, ck, cv, positions, use_pallas=use_flash)
+    elif attn_impl is not None:
+        # caller-supplied causal self-attention: the sequence-parallel
+        # training path passes ring/Ulysses attention here (q/k/v are
+        # sequence shards; global positions came in via ``positions``)
+        attn = attn_impl(q, k, v)
     elif use_flash:
         attn = flash_attention(q, k, v, causal=True)
     else:
@@ -153,12 +159,14 @@ def forward(
     positions: jnp.ndarray,  # [B, T] int32
     cache: KVCache | None = None,
     use_flash: bool = True,
+    attn_impl=None,
 ) -> tuple[jnp.ndarray, KVCache | None]:
     """Returns (logits [B, T, V], updated cache).
 
     With a cache: serves prefill (T = prompt chunk) and decode (T = 1) with
     per-sequence positions — the continuous-batching engine relies on this.
-    Without: pure causal self-attention (training / eval).
+    Without: pure causal self-attention (training / eval); ``attn_impl``
+    overrides the attention for sequence-parallel runs (ring / Ulysses).
     """
     x = embed_lookup(params["embed"], tokens)
     if cache is not None:
@@ -182,7 +190,9 @@ def forward(
         if cache is not None:
             x, ck, cv = _attention_block(x, lp, cfg, positions, mask, ck, cv, use_flash)
         else:
-            x, _, _ = _attention_block(x, lp, cfg, positions, mask, None, None, use_flash)
+            x, _, _ = _attention_block(
+                x, lp, cfg, positions, mask, None, None, use_flash, attn_impl
+            )
             ck = cv = jnp.zeros((0,), x.dtype)  # scan needs a leaf
         h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
         if cfg.is_moe:

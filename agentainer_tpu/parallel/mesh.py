@@ -52,3 +52,16 @@ def pick_tp(cfg: ModelConfig, n_devices: int) -> int:
         if n_devices % cand == 0 and cfg.n_kv_heads % cand == 0 and cfg.n_heads % cand == 0:
             tp = cand
     return tp
+
+
+def pick_ep(cfg: ModelConfig, n_devices: int) -> int:
+    """Largest ep ≤ n_devices that evenly shards the expert set — each
+    device owns E/ep experts' weights whole (the expert axis never splits
+    one expert's matrices)."""
+    if not cfg.is_moe:
+        return 1
+    ep = 1
+    for cand in range(1, max(1, n_devices) + 1):
+        if cfg.n_experts % cand == 0:
+            ep = cand
+    return ep

@@ -26,7 +26,7 @@ from jax import shard_map
 NEG_INF = -1e30
 
 
-def _ring_local(q, k, v, *, axis_name: str, causal: bool):
+def _ring_local(q, k, v, *, axis_name: str, causal: bool, extra_vary: tuple = ()):
     """Per-device body. q/k/v: [B, T_loc, H|KV, hd] (already sharded)."""
     ax = lax.axis_index(axis_name)
     n = lax.psum(1, axis_name)
@@ -39,10 +39,12 @@ def _ring_local(q, k, v, *, axis_name: str, causal: bool):
     q_pos = ax * t_loc + jnp.arange(t_loc)  # global positions of my queries
 
     # accumulators must carry the same varying-over-axis type as the data
-    # they merge with inside the scan (new shard_map vma typing)
-    m0 = lax.pcast(jnp.full((b, kv_heads, group, t_loc), NEG_INF, jnp.float32), axis_name, to='varying')
-    l0 = lax.pcast(jnp.zeros((b, kv_heads, group, t_loc), jnp.float32), axis_name, to='varying')
-    o0 = lax.pcast(jnp.zeros((b, t_loc, kv_heads, group, hd), jnp.float32), axis_name, to='varying')
+    # they merge with inside the scan (new shard_map vma typing); with a
+    # sharded batch axis the data varies over it too
+    vary = (axis_name, *extra_vary)
+    m0 = lax.pcast(jnp.full((b, kv_heads, group, t_loc), NEG_INF, jnp.float32), vary, to='varying')
+    l0 = lax.pcast(jnp.zeros((b, kv_heads, group, t_loc), jnp.float32), vary, to='varying')
+    o0 = lax.pcast(jnp.zeros((b, t_loc, kv_heads, group, hd), jnp.float32), vary, to='varying')
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     def body(i, carry):
@@ -79,11 +81,15 @@ def ring_attention(
     mesh: Mesh,
     axis: str = "sp",
     causal: bool = True,
+    batch_axis: str | None = None,
 ) -> jnp.ndarray:
     """Full-sequence attention with inputs/outputs sequence-sharded over
-    ``axis``. Shapes: q [B, T, H, hd], k/v [B, T, KV, hd] (global view)."""
-    spec = P(None, axis, None, None)
-    fn = partial(_ring_local, axis_name=axis, causal=causal)
+    ``axis``. Shapes: q [B, T, H, hd], k/v [B, T, KV, hd] (global view).
+    ``batch_axis`` additionally shards the batch dim (dp training meshes) —
+    the ring then runs independently per batch shard."""
+    spec = P(batch_axis, axis, None, None)
+    extra = (batch_axis,) if batch_axis else ()
+    fn = partial(_ring_local, axis_name=axis, causal=causal, extra_vary=extra)
     return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)

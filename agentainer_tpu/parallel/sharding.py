@@ -98,9 +98,14 @@ def batch_spec() -> P:
     return P("dp", "sp")
 
 
-def cache_specs() -> P:
-    """KV cache [L, B, S, KV, hd]: batch over dp, heads over tp."""
-    return P(None, "dp", None, "tp", None)
+def cache_specs(sp: bool = False) -> P:
+    """KV cache [L, B, S, KV, hd]: batch over dp, heads over tp; with
+    ``sp`` the SEQUENCE axis also shards — each chip holds S/sp of the
+    arena, so serving context scales past one chip's HBM. Attention over
+    the sharded axis partitions into per-chip partial softmax + psum
+    combines (distributed flash-decode), inserted by XLA from these
+    annotations."""
+    return P(None, "dp", "sp" if sp else None, "tp", None)
 
 
 def shard_params(params: dict, mesh: Mesh, moe: bool = False) -> dict:

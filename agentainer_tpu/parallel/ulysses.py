@@ -43,11 +43,12 @@ def ulysses_attention(
     mesh: Mesh,
     axis: str = "sp",
     causal: bool = True,
+    batch_axis: str | None = None,
 ) -> jnp.ndarray:
     sp = mesh.shape[axis]
     if k.shape[2] % sp != 0:
         raise ValueError(f"sp={sp} must divide n_kv_heads={k.shape[2]} for Ulysses")
-    spec = P(None, axis, None, None)
+    spec = P(batch_axis, axis, None, None)
     fn = partial(_ulysses_local, axis_name=axis, causal=causal)
     return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec

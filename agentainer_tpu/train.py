@@ -29,11 +29,27 @@ class TrainState(NamedTuple):
     step: jnp.ndarray
 
 
-def loss_fn(params: dict, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:
-    """Causal LM loss: predict tokens[:, 1:] from tokens[:, :-1]."""
+def loss_fn(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,
+    attn_impl=None,
+    input_sharding=None,
+) -> jnp.ndarray:
+    """Causal LM loss: predict tokens[:, 1:] from tokens[:, :-1].
+
+    ``input_sharding`` re-shards the sliced inputs (sequence-parallel runs:
+    raw tokens arrive dp-sharded because T+1 doesn't divide by sp; the T-long
+    inputs do, and annotating them here makes ALL activation compute —
+    embed, MLP, logits — sequence-sharded, not just the attention)."""
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    if input_sharding is not None:
+        inputs = jax.lax.with_sharding_constraint(inputs, input_sharding)
+        targets = jax.lax.with_sharding_constraint(targets, input_sharding)
     positions = jnp.broadcast_to(jnp.arange(inputs.shape[1]), inputs.shape)
-    logits, _ = forward(params, cfg, inputs, positions, cache=None, use_flash=False)
+    logits, _ = forward(
+        params, cfg, inputs, positions, cache=None, use_flash=False, attn_impl=attn_impl
+    )
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return jnp.mean(nll)
@@ -44,15 +60,48 @@ def make_train_step(
     mesh: Mesh,
     learning_rate: float = 3e-4,
     weight_decay: float = 0.01,
+    seq_attn: str = "auto",
 ):
-    """Returns (init_fn, step_fn), both jitted with mesh shardings."""
+    """Returns (init_fn, step_fn), both jitted with mesh shardings.
+
+    ``seq_attn`` selects the attention for sequence-parallel meshes
+    (sp > 1): "ring" rotates KV blocks around the sp axis with ppermute
+    (parallel/ring_attention.py — sequences longer than one device holds),
+    "ulysses" all-to-alls heads (sp ≤ kv_heads, cheaper when the full
+    sequence fits per device), "auto" picks ulysses when it divides the
+    KV heads, else ring; "none" leaves attention to GSPMD propagation.
+    """
     tx = optax.adamw(learning_rate, weight_decay=weight_decay)
+    sp = int(mesh.shape.get("sp", 1))
+    attn_impl = None
+    if sp > 1 and seq_attn != "none":
+        if seq_attn == "auto":
+            seq_attn = "ulysses" if cfg.n_kv_heads % sp == 0 else "ring"
+        if seq_attn == "ulysses":
+            from .parallel.ulysses import ulysses_attention
+
+            def attn_impl(q, k, v):
+                return ulysses_attention(q, k, v, mesh, axis="sp", batch_axis="dp")
+
+        elif seq_attn == "ring":
+            from .parallel.ring_attention import ring_attention
+
+            def attn_impl(q, k, v):
+                return ring_attention(q, k, v, mesh, axis="sp", batch_axis="dp")
+
+        else:
+            raise ValueError(f"unknown seq_attn {seq_attn!r}")
     p_shard = param_shardings(mesh, moe=cfg.is_moe)
     repl = NamedSharding(mesh, P())
-    data = NamedSharding(mesh, batch_spec())
+    # sp runs: tokens are [B, T+1] and T+1 need not divide by sp — place
+    # them dp-sharded and let loss_fn re-shard the T-long slice over sp
+    data = NamedSharding(mesh, P("dp", None) if sp > 1 else batch_spec())
+    input_sharding = NamedSharding(mesh, batch_spec()) if sp > 1 else None
 
     def step(state: TrainState, tokens: jnp.ndarray) -> tuple[TrainState, jnp.ndarray]:
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, cfg, tokens)
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state.params, cfg, tokens, attn_impl, input_sharding
+        )
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1), loss

@@ -102,3 +102,15 @@ def test_tp_session_snapshot_restore_roundtrip():
         asyncio.run(turn(engine2, "second turn"))
     finally:
         engine2.shutdown()
+
+
+def test_dense_chips_default_to_tp_spanning_assignment():
+    """A dense agent assigned N chips with no explicit tp spans them all —
+    the scheduler sized the assignment; idle chips help nobody. (The
+    control plane no longer injects tp; LLMEngine.create derives it.)"""
+    engine = LLMEngine.create("tiny", options={"chips": [0, 1], "max_batch": 2, "max_seq": 128})
+    try:
+        assert engine.tp == 2
+        assert {d.id for d in engine.cache.k.sharding.device_set} == {0, 1}
+    finally:
+        engine.shutdown()

@@ -1,0 +1,108 @@
+"""Long-context SERVING: the engine's sp mode shards the KV arena over the
+sequence axis (parallel/sharding.cache_specs(sp=True)), so serving context
+scales past one chip's HBM — per-chip arena memory is S/sp. Attention over
+the sharded axis partitions into per-chip partial softmax + psum combines
+(XLA-inserted, distributed flash-decode). VERDICT round-1 item 6; reference
+counterpart is the last-3-turns context ceiling in its example agents.
+
+Runs on the virtual 8-device CPU mesh (tests/conftest.py).
+"""
+
+import asyncio
+
+import jax
+import pytest
+
+from agentainer_tpu.engine.llm import LLMEngine
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 4, reason="needs the virtual multi-device mesh"
+)
+
+# > 64 tokens: crosses one device's S/sp=64 arena shard at max_seq=256 sp=4
+LONG_PROMPT = " ".join(f"tok{i}" for i in range(150))
+
+
+def _mk(**opts) -> LLMEngine:
+    options = {"max_batch": 2, "max_seq": 256, "prefill_chunk": 32}
+    options.update(opts)
+    return LLMEngine.create("tiny", options=options)
+
+
+def _gen(engine, prompt=LONG_PROMPT, n=6):
+    async def go():
+        return await engine.generate(prompt, max_tokens=n)
+
+    return asyncio.run(go())
+
+
+def test_sp_engine_shards_arena_over_sequence():
+    engine = _mk(sp=4)
+    try:
+        assert engine.sp == 4 and engine.tp == 1
+        assert len(engine.cache.k.sharding.device_set) == 4
+        # the sequence axis (axis 2 of [L,B,S,KV,hd]) is the sharded one:
+        # one chip holds a [L,B,S/4,KV,hd] shard
+        shard_shape = engine.cache.k.sharding.shard_shape(engine.cache.k.shape)
+        assert shard_shape[2] == engine.max_seq // 4
+        assert _gen(engine)["completion_tokens"] == 6
+    finally:
+        engine.shutdown()
+
+
+def test_sp_matches_single_device_beyond_one_shard():
+    """A prompt longer than one device's arena shard decodes to the same
+    greedy tokens as the unsharded engine — sequence sharding relocates
+    KV, not the math."""
+    e1, e2 = _mk(), _mk(sp=4)
+    try:
+        r1, r2 = _gen(e1), _gen(e2)
+        assert len(r1["tokens"]) == 6
+        assert r1["tokens"] == r2["tokens"], (r1["tokens"], r2["tokens"])
+    finally:
+        e1.shutdown()
+        e2.shutdown()
+
+
+def test_sp_composes_with_tp():
+    """tp=2 × sp=2: heads AND sequence shard together; tokens unchanged."""
+    e1, e2 = _mk(), _mk(tp=2, sp=2)
+    try:
+        assert e2.tp == 2 and e2.sp == 2
+        assert len(e2.cache.k.sharding.device_set) == 4
+        r1, r2 = _gen(e1), _gen(e2)
+        assert r1["tokens"] == r2["tokens"], (r1["tokens"], r2["tokens"])
+    finally:
+        e1.shutdown()
+        e2.shutdown()
+
+
+def test_sp_raises_default_context_cap():
+    """With sp the default serving context scales sp× (the round-1 engine
+    capped every model at 2048)."""
+    from agentainer_tpu.models.configs import get_config
+
+    # tiny's max_seq_len (256) still caps; use the cfg to compute expectation
+    e = LLMEngine.create("tiny", options={"sp": 4, "max_batch": 2, "prefill_chunk": 32})
+    try:
+        assert e.max_seq == min(get_config("tiny").max_seq_len, 2048 * 4)
+    finally:
+        e.shutdown()
+
+
+def test_sp_session_multiturn_context_survives():
+    """Multi-turn chat on an sp engine: KV context accumulated across turns
+    (beyond one shard) still conditions later replies."""
+    engine = _mk(sp=4)
+    try:
+
+        async def turn(msg, n=4):
+            return await engine.chat(session="s", message=msg, max_tokens=n)
+
+        asyncio.run(turn(LONG_PROMPT))
+        slot = engine.slots[engine.sessions["s"]]
+        assert slot.position > engine.max_seq // 4  # context crossed a shard
+        r = asyncio.run(turn("and then"))
+        assert r["completion_tokens"] == 4
+    finally:
+        engine.shutdown()

@@ -255,6 +255,21 @@ def cmd_audit(args) -> None:
 
 
 def cmd_atlogs(args) -> None:
+    if getattr(args, "follow", False):
+        # stream JSON-lines from the logs:stream channel (TailLogs parity)
+        url = _base(args) + f"/logs?follow=1&limit={args.limit}"
+        if args.component:
+            url += f"&component={args.component}"
+        with http.request("GET", url, headers=_headers(args), stream=True, timeout=None) as resp:
+            for raw in resp.iter_lines():
+                if not raw:
+                    continue
+                try:
+                    e = json.loads(raw)
+                    print(f"{e['ts']:.0f}  {e['level']:<5} {e['component']:<12} {e['message']}", flush=True)
+                except (ValueError, KeyError):
+                    print(raw.decode(errors="replace"), flush=True)
+        return
     path = f"/logs?limit={args.limit}"
     if args.component:
         path += f"&component={args.component}"
@@ -358,6 +373,7 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("logs-server", help="control-plane structured logs")
     s.add_argument("--limit", type=int, default=50)
     s.add_argument("--component", default="")
+    s.add_argument("-f", "--follow", action="store_true", help="stream live entries")
     s.set_defaults(fn=cmd_atlogs)
 
     return p

@@ -9,6 +9,7 @@ named axes
     tp  — tensor parallel (attention heads / FFN width over ICI)
     sp  — sequence/context parallel (ring attention / Ulysses)
     ep  — expert parallel (MoE all-to-all)
+    pp  — pipeline parallel (layer stages, collective_permute between)
 
 Axis sizes are chosen to divide the model's head/expert counts; XLA/GSPMD
 inserts the all-gathers/reduce-scatters implied by the sharding annotations
@@ -29,19 +30,22 @@ def make_mesh(
     tp: int = 1,
     sp: int = 1,
     ep: int = 1,
+    pp: int = 1,
     devices: list | None = None,
 ) -> Mesh:
-    """Mesh with axes (dp, tp, sp, ep); dp absorbs the remaining devices."""
+    """Mesh with axes (dp, tp, sp, ep, pp); dp absorbs the remaining
+    devices. pp is last so pipeline stages are the widest strides — on a
+    physical slice that places a stage's tp/sp group on ICI neighbors."""
     devs = devices if devices is not None else jax.devices()
     if n_devices is not None:
         devs = devs[:n_devices]
     n = len(devs)
-    denom = tp * sp * ep
+    denom = tp * sp * ep * pp
     if n % denom != 0:
-        raise ValueError(f"{n} devices not divisible by tp*sp*ep={denom}")
+        raise ValueError(f"{n} devices not divisible by tp*sp*ep*pp={denom}")
     dp = n // denom
-    arr = np.array(devs).reshape(dp, tp, sp, ep)
-    return Mesh(arr, axis_names=("dp", "tp", "sp", "ep"))
+    arr = np.array(devs).reshape(dp, pp, tp, sp, ep).transpose(0, 2, 3, 4, 1)
+    return Mesh(arr, axis_names=("dp", "tp", "sp", "ep", "pp"))
 
 
 def pick_tp(cfg: ModelConfig, n_devices: int) -> int:

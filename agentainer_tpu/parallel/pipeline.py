@@ -1,0 +1,164 @@
+"""Pipeline parallelism — GPipe-style SPMD over the ``pp`` mesh axis.
+
+Green-field TPU-first design (SURVEY.md §2.3 names PP as a required
+mechanism; the reference's only scale-out is container replicas,
+/root/reference/internal/config/deployment.go:162-230). The stacked-layer
+parameterization (models/llama.py: every per-layer weight carries a
+leading ``[L]`` axis) is the natural substrate:
+
+- **stage = layer-shard**: the ``[L, ...]`` axis shards over ``pp`` —
+  each device holds L/pp layers' weights in HBM (the memory win that
+  lets a model deeper than one chip's HBM train at all);
+- **microbatch streaming**: the batch splits into M microbatches; one
+  training step runs M + pp - 1 ticks, each tick every stage applies its
+  local layers to its in-flight microbatch, then activations rotate to
+  the next stage with ``ppermute`` (XLA collective-permute on ICI);
+- **bubble fraction** is (pp-1)/(M+pp-1) — callers pick M ≥ pp;
+- embed lives logically on stage 0 and the LM head on the last stage;
+  stages select their role by ``axis_index`` (no data-dependent Python).
+
+Everything is one ``shard_map`` + ``lax.scan``: a single compiled
+program, differentiable end-to-end (``ppermute`` transposes to the
+reverse rotation in the backward pass, giving the classic reverse-order
+pipeline automatically).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.configs import ModelConfig
+from ..models.llama import _attention_block, _mlp, _moe_mlp
+from ..ops.attention import causal_mask
+from ..ops.norms import rms_norm
+from ..ops.quant import dequant, embed_lookup
+
+
+def pipeline_layer_specs(moe: bool) -> dict:
+    """PartitionSpecs for the ``layers`` subtree with the leading layer
+    axis sharded over pp (each stage holds its own L/pp slice whole)."""
+    specs = {
+        "attn_norm": P("pp", None),
+        "wq": P("pp", None, None),
+        "wk": P("pp", None, None),
+        "wv": P("pp", None, None),
+        "wo": P("pp", None, None),
+        "mlp_norm": P("pp", None),
+    }
+    if moe:
+        specs.update(
+            {
+                "router": P("pp", None, None),
+                "w_gate": P("pp", None, None, None),
+                "w_up": P("pp", None, None, None),
+                "w_down": P("pp", None, None, None),
+            }
+        )
+    else:
+        specs.update(
+            {
+                "w_gate": P("pp", None, None),
+                "w_up": P("pp", None, None),
+                "w_down": P("pp", None, None),
+            }
+        )
+    return specs
+
+
+def pipeline_param_specs(moe: bool) -> dict:
+    """Full-pytree specs: layers staged over pp; embed/head replicated
+    (they belong to the first/last stage but are small next to the
+    layer stack)."""
+    return {
+        "embed": P(None, None),
+        "layers": pipeline_layer_specs(moe),
+        "final_norm": P(None),
+        "lm_head": P(None, None),
+    }
+
+
+def _apply_stage(x, lp_stack, cfg: ModelConfig, positions, mask):
+    """Run this stage's local layer stack (an inner lax.scan — same traced
+    block as the full model's, just over L/pp layers)."""
+
+    def step(x, lp):
+        lp = {k: dequant(v) for k, v in lp.items()}
+        x, _, _ = _attention_block(x, lp, cfg, positions, mask, None, None, False)
+        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + (_moe_mlp(h, lp, cfg) if cfg.is_moe else _mlp(h, lp))
+        return x, None
+
+    x, _ = lax.scan(step, x, lp_stack)
+    return x
+
+
+def make_pipeline_loss(cfg: ModelConfig, mesh: Mesh, n_microbatch: int | None = None):
+    """Causal-LM loss with the layer stack pipelined over ``pp``.
+
+    Returns ``loss(params, tokens)`` where tokens is ``[B, T+1]``
+    (replicated; B must divide by the microbatch count, default pp).
+    """
+    pp = int(mesh.shape["pp"])
+    M = int(n_microbatch or pp)
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+    layer_specs = pipeline_layer_specs(cfg.is_moe)
+
+    def local(layers_local, embed, final_norm, lm_head, inp, tgt):
+        # inp/tgt [M, mb, T] replicated; layers_local [L/pp, ...]
+        stage = lax.axis_index("pp")
+        mb, t = inp.shape[1], inp.shape[2]
+        positions = jnp.broadcast_to(jnp.arange(t), (mb, t))
+        mask = jnp.broadcast_to(causal_mask(t), (mb, t, t))
+        x_all = embed_lookup(embed, inp)  # [M, mb, T, D]
+        state = lax.pcast(jnp.zeros_like(x_all[0]), ("pp",), to="varying")
+        loss0 = lax.pcast(jnp.zeros((), jnp.float32), ("pp",), to="varying")
+
+        def tick(carry, ti):
+            state, loss_acc = carry
+            # stage 0 picks up the next microbatch (clip: trailing drain
+            # ticks re-feed the last one; its output is never accumulated)
+            feed = x_all[jnp.clip(ti, 0, M - 1)]
+            state = jnp.where(stage == 0, feed, state)
+            state = _apply_stage(state, layers_local, cfg, positions, mask)
+            # last stage: microbatch ti-(pp-1) exits now — score it
+            h = rms_norm(state, final_norm, cfg.norm_eps)
+            logits = (h @ dequant(lm_head)).astype(jnp.float32)
+            mi = jnp.clip(ti - (pp - 1), 0, M - 1)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, tgt[mi][..., None], axis=-1)[..., 0]
+            valid = jnp.logical_and(stage == pp - 1, ti >= pp - 1)
+            loss_acc = loss_acc + jnp.where(valid, jnp.mean(nll), 0.0)
+            state = lax.ppermute(state, "pp", perm)
+            return (state, loss_acc), None
+
+        (_, loss_acc), _ = lax.scan(tick, (state, loss0), jnp.arange(M + pp - 1))
+        return lax.psum(loss_acc, "pp") / M
+
+    repl = P()
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(layer_specs, P(None, None), P(None), P(None, None), repl, repl),
+        out_specs=repl,
+    )
+    def sharded(layers, embed, final_norm, lm_head, inp, tgt):
+        return local(layers, embed, final_norm, lm_head, inp, tgt)
+
+    def loss(params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        b, t = inputs.shape
+        if b % M:
+            raise ValueError(f"batch {b} must divide into {M} microbatches")
+        mb = b // M
+        inp = inputs.reshape(M, mb, t)
+        tgt = targets.reshape(M, mb, t)
+        return sharded(params["layers"], params["embed"], params["final_norm"], params["lm_head"], inp, tgt)
+
+    return loss

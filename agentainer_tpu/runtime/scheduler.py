@@ -7,9 +7,9 @@ the slice an agent's engine binds, and how much HBM it may claim for weights
 + KV. The scheduler is the source of the device mesh each engine builds.
 
 Model: a slice is ``total_chips`` chips (e.g. v5e-8) with ``hbm_per_chip``
-bytes each (16 GiB on v5e). An allocation is a contiguous run of chip ids —
-contiguity keeps ICI neighbors adjacent so TP/ring collectives ride the
-physical ring rather than hopping. Weight-sharing groups let several agents
+bytes each (16 GiB on v5e), laid out as a 2-D mesh (v5e-8 is 2×4). An
+allocation is an ICI-adjacent sub-rectangle of that grid, so TP/ring
+collectives ride physical neighbor links. Weight-sharing groups let several agents
 serving the same model config co-locate on the same chips and count the
 weight bytes once (the multi-agent HBM-sharing feature of BASELINE.json
 config #4).
@@ -58,9 +58,66 @@ class Placement:
 
 @dataclass
 class SliceTopology:
+    """A TPU slice as a 2-D chip grid.
+
+    v5e-8 is physically a 2×4 mesh, not a ring — "adjacent" means
+    neighboring in the grid, and an ICI-efficient allocation is a
+    sub-RECTANGLE of it (round-1's 1-D "contiguous id run" model called
+    chips 3 and 4 neighbors; on the real 2×4 grid they're in different
+    rows). Chip ids are row-major over ``mesh_shape``.
+    """
+
     total_chips: int = 8
     hbm_per_chip: int = HBM_PER_CHIP_V5E
     name: str = "v5e-8"
+    mesh_shape: tuple[int, int] = (2, 4)  # (rows, cols)
+
+    def __post_init__(self) -> None:
+        rows, cols = self.mesh_shape
+        if rows * cols != self.total_chips:
+            # derive the squarest grid for the chip count (the shape daemon
+            # configs omit): 8→2×4, 16→4×4, 4→2×2; primes degenerate to a row
+            r = max(d for d in range(1, int(self.total_chips**0.5) + 1)
+                    if self.total_chips % d == 0)
+            self.mesh_shape = (r, self.total_chips // r)
+
+    def windows(self, n: int) -> list[tuple[int, ...]]:
+        """Candidate ICI-adjacent chip sets of size n, preference-ordered.
+
+        Sub-rectangles of the grid (squarer first — shorter worst-case
+        ICI hop for TP all-reduces / ring collectives), deduplicated. If
+        no h×w rectangle has area n (e.g. n=3 on 2×4 → the 1×3 row run IS
+        a rectangle; n=5 has none), fall back to row-major id runs so odd
+        requests still place (with a wraparound hop the caller accepted
+        by asking for a non-rectangular count)."""
+        rows, cols = self.mesh_shape
+        shapes = [
+            (h, w)
+            for h in range(1, rows + 1)
+            for w in range(1, cols + 1)
+            if h * w == n
+        ]
+        shapes.sort(key=lambda s: (max(s), s[0]))
+        out: list[tuple[int, ...]] = []
+        seen: set[tuple[int, ...]] = set()
+        for h, w in shapes:
+            for r in range(rows - h + 1):
+                for c in range(cols - w + 1):
+                    win = tuple(
+                        sorted(
+                            rr * cols + cc
+                            for rr in range(r, r + h)
+                            for cc in range(c, c + w)
+                        )
+                    )
+                    if win not in seen:
+                        seen.add(win)
+                        out.append(win)
+        if not out:
+            out = [
+                tuple(range(s, s + n)) for s in range(self.total_chips - n + 1)
+            ]
+        return out
 
 
 class SliceScheduler:
@@ -149,17 +206,18 @@ class SliceScheduler:
                     # (weights not shared rather than silently overcommitted)
                     share_group = ""
 
-            # First-fit contiguous window scan.
-            for start in range(0, self.topology.total_chips - n + 1):
-                window = tuple(range(start, start + n))
+            # First-fit over ICI-adjacent windows (sub-rectangles of the
+            # 2-D chip grid, squarer first — see SliceTopology.windows).
+            for window in self.topology.windows(n):
                 if all(usage[c] + need_per_chip <= self.topology.hbm_per_chip for c in window):
                     placement = Placement(agent.id, window, agent.resources.hbm_bytes, share_group)
                     self._placements[agent.id] = placement
                     self._save()
                     return placement
             raise ResourceExhausted(
-                f"no contiguous {n}-chip window with {need_per_chip} B free HBM per chip "
-                f"on {self.topology.name}"
+                f"no ICI-adjacent {n}-chip window with {need_per_chip} B free HBM per chip "
+                f"on {self.topology.name} ({self.topology.mesh_shape[0]}x"
+                f"{self.topology.mesh_shape[1]} mesh)"
             )
 
     def release(self, agent_id: str) -> None:

@@ -400,8 +400,15 @@ class ControlPlaneApp:
             out[agent_id] = self.s.metrics.current(agent_id)
         return ok(out)
 
-    async def h_get_logs(self, request: web.Request) -> web.Response:
+    async def h_get_logs(self, request: web.Request) -> web.StreamResponse:
         q = request.query
+        if q.get("follow", "").lower() not in ("", "0", "false"):
+            return await self._follow_server_logs(
+                request,
+                tail=int(q.get("limit", "20")),
+                level=q.get("level", ""),
+                component=q.get("component", ""),
+            )
         return ok(
             self.s.logs.get_logs(
                 level=q.get("level", ""),
@@ -410,6 +417,63 @@ class ControlPlaneApp:
                 limit=int(q.get("limit", "100")),
             )
         )
+
+    async def _follow_server_logs(
+        self, request: web.Request, tail: int, level: str = "", component: str = ""
+    ) -> web.StreamResponse:
+        """Stream the control plane's structured log as JSON lines: a tail
+        of recent entries, then live entries from the ``logs:stream``
+        pub/sub channel until the client disconnects (the reference's
+        TailLogs surface, logger.go:459-493 — round 1 published the
+        channel but nothing consumed it). Filters apply to both the tail
+        and the live stream. The subscription attaches AFTER the tail
+        snapshot (tail -f semantics: no duplicates; an entry logged in
+        that instant may be absent from the tail)."""
+
+        def matches(entry: dict) -> bool:
+            if level and entry.get("level") != level:
+                return False
+            if component and entry.get("component") != component:
+                return False
+            return True
+
+        resp = web.StreamResponse(
+            headers={"Content-Type": "application/x-ndjson; charset=utf-8"}
+        )
+        await resp.prepare(request)
+        loop = asyncio.get_running_loop()
+        queue: asyncio.Queue[str] = asyncio.Queue(maxsize=1000)
+
+        def on_entry(_channel: str, message: str) -> None:
+            # publisher thread → loop; drop on overflow (a stalled client
+            # must not backpressure the logging plane)
+            def put():
+                if not queue.full():
+                    queue.put_nowait(message)
+
+            loop.call_soon_threadsafe(put)
+
+        unsubscribe = None
+        try:
+            for entry in self.s.logs.get_logs(
+                level=level, component=component, limit=tail
+            ):
+                await resp.write(json.dumps(entry).encode() + b"\n")
+            unsubscribe = self.s.store.on_message(Keys.LOG_STREAM, on_entry)
+            while True:
+                line = await queue.get()
+                try:
+                    if not matches(json.loads(line)):
+                        continue
+                except ValueError:
+                    pass
+                await resp.write(line.encode() + b"\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            if unsubscribe is not None:
+                unsubscribe()
+        return resp
 
     async def h_get_audit(self, request: web.Request) -> web.Response:
         q = request.query

@@ -36,12 +36,29 @@ def open_store(url: str | None = None) -> Store:
     if not url or url.startswith("mem://"):
         return MemoryStore()
     if url.startswith("native://"):
+        aof = url[len("native://") :]
         try:
             from .native import NativeStore
 
-            aof = url[len("native://") :]
             return NativeStore(aof_path=aof or None)
-        except Exception:
+        except Exception as e:
+            if aof:
+                # an AOF path is a durability REQUEST: a daemon that
+                # believes it has crash-safe state must never silently run
+                # on a memory store (VERDICT round-1 weak #7)
+                raise RuntimeError(
+                    f"native store with AOF durability requested ({url!r}) "
+                    f"but unavailable: {e!r}. Refusing to downgrade "
+                    "silently — build native/ (make -C native) or pass "
+                    "mem:// to explicitly run without durability"
+                ) from e
+            import logging
+
+            logging.getLogger("agentainer").error(
+                "native store unavailable (%s); falling back to the "
+                "non-durable MemoryStore (no AOF path was requested)",
+                e,
+            )
             return MemoryStore()
     if url.startswith("redis://"):
         raise RuntimeError(

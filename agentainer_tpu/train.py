@@ -61,6 +61,7 @@ def make_train_step(
     learning_rate: float = 3e-4,
     weight_decay: float = 0.01,
     seq_attn: str = "auto",
+    n_microbatch: int | None = None,
 ):
     """Returns (init_fn, step_fn), both jitted with mesh shardings.
 
@@ -70,9 +71,14 @@ def make_train_step(
     "ulysses" all-to-alls heads (sp ≤ kv_heads, cheaper when the full
     sequence fits per device), "auto" picks ulysses when it divides the
     KV heads, else ring; "none" leaves attention to GSPMD propagation.
+
+    A mesh with pp > 1 pipelines the layer stack instead (GPipe-style,
+    parallel/pipeline.py): each stage holds L/pp layers, ``n_microbatch``
+    microbatches stream through with collective_permute between stages.
     """
     tx = optax.adamw(learning_rate, weight_decay=weight_decay)
     sp = int(mesh.shape.get("sp", 1))
+    pp = int(mesh.shape.get("pp", 1))
     attn_impl = None
     if sp > 1 and seq_attn != "none":
         if seq_attn == "auto":
@@ -91,17 +97,40 @@ def make_train_step(
 
         else:
             raise ValueError(f"unknown seq_attn {seq_attn!r}")
-    p_shard = param_shardings(mesh, moe=cfg.is_moe)
     repl = NamedSharding(mesh, P())
-    # sp runs: tokens are [B, T+1] and T+1 need not divide by sp — place
-    # them dp-sharded and let loss_fn re-shard the T-long slice over sp
-    data = NamedSharding(mesh, P("dp", None) if sp > 1 else batch_spec())
-    input_sharding = NamedSharding(mesh, batch_spec()) if sp > 1 else None
+    if pp > 1:
+        from .parallel.pipeline import make_pipeline_loss, pipeline_param_specs
+
+        if cfg.n_layers % pp:
+            raise ValueError(f"pp={pp} must divide n_layers={cfg.n_layers}")
+        # v0 pipelines compose only with dp (replicated tokens): a pp mesh
+        # with tp/sp/ep axes would silently replicate per-stage weights and
+        # skip the collective attention — refuse instead
+        others = {a: int(mesh.shape.get(a, 1)) for a in ("tp", "sp", "ep")}
+        if any(v > 1 for v in others.values()):
+            raise ValueError(
+                f"pipeline parallelism does not compose with {others} yet; "
+                "use a dp×pp mesh"
+            )
+        p_shard = jax.tree.map(
+            lambda s: NamedSharding(mesh, s),
+            pipeline_param_specs(cfg.is_moe),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        data = repl  # microbatches stream from replicated tokens (v0)
+        compute_loss = make_pipeline_loss(cfg, mesh, n_microbatch)
+    else:
+        p_shard = param_shardings(mesh, moe=cfg.is_moe)
+        # sp runs: tokens are [B, T+1] and T+1 need not divide by sp — place
+        # them dp-sharded and let loss_fn re-shard the T-long slice over sp
+        data = NamedSharding(mesh, P("dp", None) if sp > 1 else batch_spec())
+        input_sharding = NamedSharding(mesh, batch_spec()) if sp > 1 else None
+
+        def compute_loss(params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
+            return loss_fn(params, cfg, tokens, attn_impl, input_sharding)
 
     def step(state: TrainState, tokens: jnp.ndarray) -> tuple[TrainState, jnp.ndarray]:
-        loss, grads = jax.value_and_grad(loss_fn)(
-            state.params, cfg, tokens, attn_impl, input_sharding
-        )
+        loss, grads = jax.value_and_grad(compute_loss)(state.params, tokens)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1), loss

@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# Verify the environment and build the native components.
+# (Reference role: scripts/install-prerequisites.sh — Docker/Redis checks
+# become Python/JAX/toolchain checks here; nothing is installed, only
+# verified, because TPU-VM images bake the deps.)
+set -uo pipefail
+
+ok=0; fail=0
+check() {
+    if eval "$2" >/dev/null 2>&1; then
+        echo "  ok: $1"; ok=$((ok+1))
+    else
+        echo "  MISSING: $1   ($3)"; fail=$((fail+1))
+    fi
+}
+
+echo "python environment:"
+check "python >= 3.10"        "python -c 'import sys; assert sys.version_info >= (3,10)'" "install python3.10+"
+check "jax"                   "python -c 'import jax'"            "pip install jax"
+check "aiohttp"               "python -c 'import aiohttp'"        "pip install aiohttp"
+check "numpy"                 "python -c 'import numpy'"          "pip install numpy"
+check "optax (training)"      "python -c 'import optax'"          "pip install optax"
+check "orbax (checkpoints)"   "python -c 'import orbax.checkpoint'" "pip install orbax-checkpoint"
+check "safetensors (HF import)" "python -c 'import safetensors'"  "pip install safetensors"
+check "pytest (tests)"        "python -c 'import pytest'"         "pip install pytest"
+
+echo "native toolchain:"
+check "g++"                   "command -v g++"                    "apt install g++"
+check "make"                  "command -v make"                   "apt install make"
+
+echo "accelerator:"
+timeout 20 python - <<'PY' 2>/dev/null || echo "  note: no TPU visible or probe timed out (CPU fallback works for control plane + tests)"
+import jax
+ds = jax.devices()
+print(f"  ok: {len(ds)} {ds[0].platform} device(s)")
+PY
+
+if [[ $fail -eq 0 ]]; then
+    echo "building native store + data plane..."
+    if make -C "$(dirname "$0")/../native" >/dev/null; then
+        echo "  ok: native/build/libagentainer_native.so"
+    else
+        echo "  MISSING: native build failed (control plane falls back to the in-memory store; pass ATPU_STORE_URL=mem:// to acknowledge)"
+    fi
+fi
+echo "$ok checks passed, $fail missing"
+exit $((fail > 0))

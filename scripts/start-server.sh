@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Start the control-plane daemon in the background with a durable store.
+# (Reference role: scripts/start-server.sh:1-52, which boots the server
+# container + Redis sidecar; here the store is embedded so one process
+# suffices.)
+set -euo pipefail
+
+ATPU_DATA_DIR="${ATPU_DATA_DIR:-$HOME/.agentainer}"
+ATPU_SERVER_PORT="${ATPU_SERVER_PORT:-8081}"
+ATPU_STORE_URL="${ATPU_STORE_URL:-native://$ATPU_DATA_DIR/store.aof}"
+PIDFILE="$ATPU_DATA_DIR/agentainer.pid"
+LOGFILE="$ATPU_DATA_DIR/daemon.log"
+
+mkdir -p "$ATPU_DATA_DIR"
+
+if [[ -f "$PIDFILE" ]] && kill -0 "$(cat "$PIDFILE")" 2>/dev/null; then
+    echo "already running (pid $(cat "$PIDFILE"))"
+    exit 0
+fi
+
+# build the native store/data plane if it isn't there yet
+if [[ ! -f "$(dirname "$0")/../native/build/libagentainer_native.so" ]]; then
+    echo "building native components..."
+    make -C "$(dirname "$0")/../native" >/dev/null
+fi
+
+export ATPU_DATA_DIR ATPU_SERVER_PORT ATPU_STORE_URL
+nohup python -m agentainer_tpu.cli server --port "$ATPU_SERVER_PORT" \
+    >> "$LOGFILE" 2>&1 &
+echo $! > "$PIDFILE"
+
+for _ in $(seq 1 50); do
+    if curl -fsS "http://127.0.0.1:$ATPU_SERVER_PORT/health" >/dev/null 2>&1; then
+        echo "agentainer server up on :$ATPU_SERVER_PORT (pid $(cat "$PIDFILE"), data in $ATPU_DATA_DIR)"
+        exit 0
+    fi
+    sleep 0.2
+done
+echo "server did not become healthy; see $LOGFILE" >&2
+exit 1

@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Graceful shutdown of the control-plane daemon and its engine
+# subprocesses. (Reference role: scripts/stop-server.sh.)
+set -euo pipefail
+
+ATPU_DATA_DIR="${ATPU_DATA_DIR:-$HOME/.agentainer}"
+PIDFILE="$ATPU_DATA_DIR/agentainer.pid"
+
+if [[ ! -f "$PIDFILE" ]]; then
+    echo "not running (no $PIDFILE)"
+    exit 0
+fi
+PID=$(cat "$PIDFILE")
+if ! kill -0 "$PID" 2>/dev/null; then
+    echo "stale pidfile removed"
+    rm -f "$PIDFILE"
+    exit 0
+fi
+kill "$PID"   # SIGTERM: daemon stops engines (SIGTERM→10s→SIGKILL) then exits
+for _ in $(seq 1 100); do
+    kill -0 "$PID" 2>/dev/null || { rm -f "$PIDFILE"; echo "stopped"; exit 0; }
+    sleep 0.2
+done
+echo "did not exit after 20s; forcing" >&2
+kill -9 "$PID" 2>/dev/null || true
+rm -f "$PIDFILE"

@@ -134,15 +134,17 @@ def test_status_published_on_change(mgr):
     assert (Keys.status_channel(agent.id), "running") in got
 
 
-def test_scheduler_contiguous_and_exhaustion(mgr):
+def test_scheduler_adjacent_windows_and_exhaustion(mgr):
+    """Two 4-chip agents get disjoint 2×2 sub-rectangles of the v5e-8 2×4
+    grid (ICI-adjacent blocks, not 1-D id runs); a third agent exhausts."""
     topo = mgr.scheduler.topology
     a = mgr.deploy("a", "echo", resources=Resources(chips=4, hbm_bytes=4 * topo.hbm_per_chip))
     b = mgr.deploy("b", "echo", resources=Resources(chips=4, hbm_bytes=4 * topo.hbm_per_chip))
     mgr.start(a.id)
     mgr.start(b.id)
     pa, pb = mgr.scheduler.placement(a.id), mgr.scheduler.placement(b.id)
-    assert pa.chips == (0, 1, 2, 3)
-    assert pb.chips == (4, 5, 6, 7)
+    assert pa.chips == (0, 1, 4, 5)  # 2×2 block: cols 0-1 of both rows
+    assert pb.chips == (2, 3, 6, 7)  # the remaining 2×2 block
     c = mgr.deploy("c", "echo", resources=Resources(chips=1, hbm_bytes=topo.hbm_per_chip))
     with pytest.raises(ResourceExhausted):
         mgr.start(c.id)
@@ -197,18 +199,69 @@ def test_scheduler_share_group_respects_capacity():
     a = mgr.deploy(
         "a", ModelRef(engine="llm", config="tiny"), resources=Resources(chips=4, hbm_bytes=8 * gib)
     )
-    mgr.start(a.id)  # group claim 2 GiB/chip on chips 0-3
+    mgr.start(a.id)  # group claim 2 GiB/chip on the first 2×2 block (0,1,4,5)
     s = mgr.deploy("s", "echo", resources=Resources(chips=4, hbm_bytes=56 * gib))
-    mgr.start(s.id)  # solo fills chips 0-3 to 16 GiB
-    assert sched.placement(s.id).chips == (0, 1, 2, 3)
-    # b wants to join the group with a bigger claim (8 GiB/chip): chips 0-3
-    # can't absorb it, so it must be placed solo elsewhere, not overcommitted
+    mgr.start(s.id)  # solo 14 GiB/chip fills the same block to 16 GiB
+    assert sched.placement(s.id).chips == (0, 1, 4, 5)
+    # b wants to join the group with a bigger claim (8 GiB/chip): the
+    # group's block can't absorb it, so it must be placed solo elsewhere,
+    # not overcommitted
     b = mgr.deploy(
         "b", ModelRef(engine="llm", config="tiny"), resources=Resources(chips=4, hbm_bytes=32 * gib)
     )
     mgr.start(b.id)
     pb = sched.placement(b.id)
-    assert pb.chips == (4, 5, 6, 7)
+    assert pb.chips == (2, 3, 6, 7)
     assert pb.share_group == ""
     free = sched.free_hbm()
     assert all(v >= 0 for v in free.values())
+
+
+def test_topology_2d_windows():
+    """v5e-8 is a 2×4 grid: windows are sub-rectangles, squarer first
+    (shorter worst-case ICI hop), and row-pairs are vertical neighbors."""
+    from agentainer_tpu.runtime.scheduler import SliceTopology
+
+    topo = SliceTopology(total_chips=8, mesh_shape=(2, 4))
+    w4 = topo.windows(4)
+    assert w4[0] == (0, 1, 4, 5)  # 2×2 beats 1×4
+    assert (0, 1, 2, 3) in w4  # row runs are still candidates
+    # chips 3 and 4 are NOT neighbors (different rows, opposite corners):
+    # no window may pair them without their rectangle closure
+    assert all(not ({3, 4} <= set(w) and len(w) == 2) for w in topo.windows(2))
+    # vertical pairs exist: (0, 4) is a 2×1 rectangle
+    assert (0, 4) in topo.windows(2)
+    # whole slice
+    assert topo.windows(8) == [(0, 1, 2, 3, 4, 5, 6, 7)]
+    # n with no exact rectangle falls back to id runs
+    assert topo.windows(5)[0] == (0, 1, 2, 3, 4)
+
+
+def test_topology_derives_grid_from_chip_count():
+    """A mesh_shape inconsistent with total_chips (daemon configs only set
+    the count) derives the squarest grid; primes degenerate to a row."""
+    from agentainer_tpu.runtime.scheduler import SliceTopology
+
+    assert SliceTopology(total_chips=4, mesh_shape=(2, 4)).mesh_shape == (2, 2)
+    assert SliceTopology(total_chips=16).mesh_shape == (4, 4)
+    topo = SliceTopology(total_chips=3)
+    assert topo.mesh_shape == (1, 3)
+    assert topo.windows(2) == [(0, 1), (1, 2)]
+
+
+def test_open_store_refuses_silent_durability_downgrade(monkeypatch, tmp_path):
+    """native:// with an AOF path must RAISE when the native library is
+    unavailable — a daemon must never believe it has durability it lacks.
+    Plain native:// (no AOF) may fall back, loudly."""
+    import agentainer_tpu.store.native as native_mod
+    from agentainer_tpu.store import MemoryStore, open_store
+
+    def boom(*a, **k):
+        raise OSError("libagentainer_native.so: not built")
+
+    monkeypatch.setattr(native_mod, "NativeStore", boom)
+    with pytest.raises(RuntimeError, match="Refusing to downgrade"):
+        open_store(f"native://{tmp_path}/store.aof")
+    s = open_store("native://")  # no AOF requested: loud fallback allowed
+    assert isinstance(s, MemoryStore)
+    s.close()

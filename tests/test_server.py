@@ -515,3 +515,38 @@ def test_tail_snapshot_exactly_once(tmp_path):
     assert len(lines) == 3000
     assert lines[-1].startswith(b"line 003999")
     assert offset == len(big)
+
+
+def test_server_logs_follow_streams_live_entries(tmp_path):
+    """GET /logs?follow=1 serves a tail then streams entries published on
+    the logs:stream channel (reference TailLogs parity, logger.go:459-493)."""
+    import asyncio as aio
+    import json as js
+
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        services.logs.info("t", "seed")
+
+        resp = await client.get("/logs", params={"follow": "1", "limit": "5"}, headers=AUTH)
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("application/x-ndjson")
+
+        async def read_lines(n):
+            out = []
+            while len(out) < n:
+                raw = await aio.wait_for(resp.content.readline(), timeout=5)
+                if raw.strip():
+                    out.append(js.loads(raw))
+            return out
+
+        tail = await read_lines(1)
+        assert tail[0]["message"] == "seed"
+        # live entry arrives over the pub/sub channel
+        services.logs.info("t2", "live-entry")
+        live = await read_lines(1)
+        assert any(e["message"] == "live-entry" for e in live)
+        resp.close()
+        await client.close()
+
+    run(body())

@@ -93,6 +93,13 @@ class Slot:
     # bumped whenever the slot is reassigned or its position resets; lets a
     # concurrent snapshot detect that its prefix went stale mid-serialize
     epoch: int = 0
+    # host mirror of the DEVICE-side decode position for this slot's lane
+    # (the pipelined decode chains positions on device; chunks already in
+    # flight were dispatched at this offset)
+    dev_position: int = 0
+    # decoding = this slot's lane in the device carry is live (its first
+    # token was injected and decode chunks are advancing it)
+    decoding: bool = False
 
 
 class LLMEngine:
@@ -178,6 +185,39 @@ class LLMEngine:
         self.slots = [Slot(i) for i in range(max_batch)]
         self.sessions: dict[str, int] = {}
 
+        # Device-side decode carry: the pipelined decode chains (token,
+        # position, temperature) per slot lane ON DEVICE across chunks, so
+        # steady-state decode never waits for a host round-trip (the axon
+        # readback RTT measured ~24 ms — serial per chunk it dominated ITL).
+        # Idle lanes park at scratch_pos exactly like the pre-pipeline
+        # design; prefill injects a finished prompt's first token into its
+        # lane with a jitted scatter instead of a host rebuild.
+        def _mk_carry():
+            return (
+                jnp.zeros((max_batch,), jnp.int32),
+                jnp.full((max_batch,), self.scratch_pos, jnp.int32),
+                jnp.zeros((max_batch,), jnp.float32),
+            )
+
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding as _NS, PartitionSpec as _P
+
+            repl = _NS(self.mesh, _P())
+            self._dtok, self._dpos, self._dtemps = jax.jit(
+                _mk_carry, out_shardings=(repl, repl, repl)
+            )()
+        else:
+            dev = devices[0] if devices else None
+            if dev is not None:
+                with jax.default_device(dev):
+                    self._dtok, self._dpos, self._dtemps = _mk_carry()
+            else:
+                self._dtok, self._dpos, self._dtemps = _mk_carry()
+        # FIFO of lagged readbacks: ("first", slot, req, first_dev, t) and
+        # ("chunk", [(slot, req, start_pos)...], toks_dev, t); staleness is
+        # detected by `slot.request is not req` identity at processing time
+        self._readbacks: collections.deque = collections.deque()
+
         self._queue: queue.Queue[GenRequest | None] = queue.Queue()
         self._completed: collections.OrderedDict[str, dict] = collections.OrderedDict()
         self._lock = threading.Lock()
@@ -193,6 +233,21 @@ class LLMEngine:
         self._occupancy_sum = 0.0
         self._last_decode_end: float | None = None
         self._started_at = time.monotonic()
+
+        # FLOP/HBM accounting (VERDICT r2 items 1-2/10): achieved model
+        # FLOPs accumulate per prefill chunk / decode token so the metrics
+        # plane can report MFU against the spanned chips' spec-sheet peak;
+        # weight/arena bytes let the scheduler's HBM claims be audited.
+        from ..utils.hw import chip_spec
+
+        self.flops_done = 0.0
+        self.param_hbm_bytes = sum(
+            x.nbytes for x in jax.tree.leaves(params)
+        )
+        self.kv_arena_bytes = cache.k.nbytes + cache.v.nbytes
+        self._n_chips = self.tp * self.ep * self.sp
+        self._chip = chip_spec((devices or jax.devices() or [None])[0])
+        self._peak_flops = self._chip.bf16_flops * self._n_chips
 
         self._build_compiled()
         self._worker = threading.Thread(target=self._loop, daemon=True, name="llm-engine")
@@ -369,9 +424,14 @@ class LLMEngine:
         def decode_n(params, cache, tokens, positions, temps, keys):
             """Kernel-looped decode: ``chunk`` autoregressive steps inside one
             compiled call (lax.scan), so the host↔device round trip is paid
-            once per chunk, not once per token. Tokens a request doesn't end
-            up using are rolled back by the worker (their cache writes are
-            overwritten before any later query can attend to them)."""
+            once per chunk, not once per token. The (token, position) carry
+            is returned so the NEXT chunk can chain on it device-side — the
+            worker never has to wait for tokens to cross the host boundary
+            between chunks. Tokens a request doesn't end up using are rolled
+            back by the worker (their cache writes are overwritten before any
+            later query can attend to them)."""
+
+            scratch = cache.k.shape[2] - 1
 
             def step(carry, key):
                 tok, pos, cache = carry
@@ -379,31 +439,51 @@ class LLMEngine:
                     params, cfg, tok[:, None], pos[:, None], cache, use_flash=use_flash
                 )
                 nxt = sample(logits[:, 0], key, temperature=temps)
-                return (nxt, pos + 1, cache), nxt
+                # clamp: parked (idle/finished) lanes decode forever at the
+                # scratch position — real lanes never reach it (admission
+                # budgets position + max_tokens below it)
+                return (nxt, jnp.minimum(pos + 1, scratch), cache), nxt
 
-            (_, _, cache), toks = lax.scan(step, (tokens, positions, cache), keys)
-            return toks, cache  # toks [chunk, B]
+            (tok, pos, cache), toks = lax.scan(step, (tokens, positions, cache), keys)
+            return toks, tok, pos, cache  # toks [chunk, B]
+
+        def inject(tok, pos, temps, idx, first, position, temp):
+            """Point a slot's decode lane at its prefill result: lane `idx`
+            continues from `first` (the sampled first token, still on
+            device) at `position`. Idle/finished lanes are parked the same
+            way with first=0, position=scratch."""
+            return (
+                tok.at[idx].set(first),
+                pos.at[idx].set(position),
+                temps.at[idx].set(temp),
+            )
 
         self._prefill = jax.jit(prefill, donate_argnums=(1,))
-        self._decode_n = jax.jit(decode_n, donate_argnums=(1,))
+        self._decode_n = jax.jit(decode_n, donate_argnums=(1, 2, 3))
+        self._inject = jax.jit(inject, donate_argnums=(0, 1, 2))
 
     def warmup(self) -> None:
-        """Compile the decode chunk and the smallest prefill bucket."""
+        """Compile the decode chunk, the injection scatter, and the smallest
+        prefill bucket."""
         toks = jnp.zeros((1, PREFILL_BUCKETS[0]), jnp.int32)
         pos = jnp.zeros((1, PREFILL_BUCKETS[0]), jnp.int32)
         _, self.cache = self._prefill(
             self.params, self.cache, jnp.int32(0), toks, pos, jnp.int32(1)
         )
-        keys = jax.random.split(self._rng, self.decode_chunk)
-        nxt, self.cache = self._decode_n(
-            self.params,
-            self.cache,
-            jnp.zeros((self.max_batch,), jnp.int32),
-            jnp.full((self.max_batch,), self.scratch_pos, jnp.int32),
-            jnp.zeros((self.max_batch,), jnp.float32),
-            keys,
+        self._dtok, self._dpos, self._dtemps = self._inject(
+            self._dtok,
+            self._dpos,
+            self._dtemps,
+            jnp.int32(0),
+            jnp.int32(0),
+            jnp.int32(self.scratch_pos),
+            jnp.float32(0.0),
         )
-        nxt.block_until_ready()
+        keys = jax.random.split(self._rng, self.decode_chunk)
+        out, self._dtok, self._dpos, self.cache = self._decode_n(
+            self.params, self.cache, self._dtok, self._dpos, self._dtemps, keys
+        )
+        np.asarray(out)  # real sync (block_until_ready is a no-op on axon)
 
     # -- public API (called from the aiohttp loop) ------------------------
     async def generate(
@@ -524,6 +604,19 @@ class LLMEngine:
             "tp": self.tp,
             "ep": self.ep,
             "sp": self.sp,
+            # FLOP model + HBM telemetry: lifetime MFU here is a floor
+            # (includes idle time); bench_llm.py samples flops_done twice
+            # and computes windowed MFU over the loaded interval
+            "flops_done": self.flops_done,
+            "mfu_lifetime": round(self.flops_done / elapsed / self._peak_flops, 5),
+            "peak_tflops": round(self._peak_flops / 1e12, 1),
+            "chip_kind": self._chip.kind,
+            "n_chips": self._n_chips,
+            "param_hbm_bytes": self.param_hbm_bytes,
+            "kv_arena_bytes": self.kv_arena_bytes,
+            "hbm_bytes_per_chip_est": int(
+                (self.param_hbm_bytes + self.kv_arena_bytes) / self._n_chips
+            ),
         }
 
     def shutdown(self) -> None:
@@ -532,12 +625,24 @@ class LLMEngine:
         self._worker.join(timeout=10)
 
     # -- worker thread ----------------------------------------------------
+    #
+    # Pipelined decode (round-3 perf work): the device carry chains decode
+    # chunks with no host round-trip between them; token readbacks are
+    # initiated asynchronously at dispatch and PROCESSED one pipeline slot
+    # later, so the axon/PCIe readback RTT rides under the next chunk's
+    # compute instead of serializing with it. Consequences the logic below
+    # accounts for: EOS/finish detection lags by up to one chunk (the extra
+    # lane-steps are parked garbage, overwritten before any query can attend
+    # to them), and a finished lane keeps decoding until its park-injection
+    # lands (clamped at the scratch position).
+    _PIPELINE_DEPTH = 1  # readback RTT < chunk compute, so depth 1 hides it
+
     def _loop(self) -> None:
         waiting: list[GenRequest] = []
         while self._running:
-            has_active = any(s.request is not None for s in self.slots)
+            busy = any(s.request is not None for s in self.slots) or bool(self._readbacks)
             try:
-                if has_active or waiting:
+                if busy or waiting:
                     item = self._queue.get_nowait()
                 else:
                     item = self._queue.get(timeout=0.2)
@@ -564,15 +669,23 @@ class LLMEngine:
                     self._fail_item(item, e)
             waiting = still
             try:
-                # ONE prefill chunk, then a decode step: a long prompt is
-                # fed through chunk-by-chunk between decode steps, so
+                # ONE prefill chunk, then a decode chunk: a long prompt is
+                # fed through chunk-by-chunk between decode chunks, so
                 # admitting it never stalls active generations for more
                 # than one chunk's latency
                 self._prefill_tick()
-                if any(s.request is not None and not s.pending_prompt for s in self.slots):
-                    self._decode_step()
+                if any(s.decoding for s in self.slots):
+                    self._decode_dispatch()
                 else:
                     self._last_decode_end = None  # idle gap isn't ITL
+                # drain landed readbacks; block on the oldest when the
+                # pipeline is full (that wait IS the backpressure bounding
+                # how far dispatch runs ahead of the device) or when there
+                # is nothing else to dispatch
+                self._drain_readbacks(
+                    block=len(self._readbacks) > self._PIPELINE_DEPTH
+                    or not any(s.decoding or s.pending_prompt for s in self.slots)
+                )
             except Exception as e:
                 # fail every in-flight request rather than hanging them
                 for slot in self.slots:
@@ -580,6 +693,8 @@ class LLMEngine:
                         self._fail_item(slot.request, e)
                         slot.request = None
                         slot.pending_prompt = []
+                        slot.decoding = False
+                self._readbacks.clear()
             if not any(s.request is not None for s in self.slots) and waiting:
                 time.sleep(0.002)  # all slots busy-by-session; brief backoff
 
@@ -682,25 +797,34 @@ class LLMEngine:
         last_logits, self.cache = self._prefill(
             self.params, self.cache, jnp.int32(slot.idx), tokens, pos, jnp.int32(n)
         )
+        # n real tokens, each attending ~its own position of context
+        self.flops_done += n * self.cfg.flops_per_token(slot.position + n // 2)
         slot.position += n
         slot.last_used = time.monotonic()
         if not final:
             return
         self._rng, key = jax.random.split(self._rng)
         first = sample(last_logits[None], key, temperature=jnp.asarray([req.temperature]))
-        first_id = int(first[0])
-        req.ttft_ms = 1000 * (time.monotonic() - req.submitted_at)
-        self.ttft_ms_recent.append(req.ttft_ms)
+        # point the slot's decode lane at this prompt's continuation WITHOUT
+        # waiting for the sampled token to reach the host — decode chunks
+        # chain from it on device; the value lands via the readback queue
+        self._dtok, self._dpos, self._dtemps = self._inject(
+            self._dtok,
+            self._dpos,
+            self._dtemps,
+            jnp.int32(slot.idx),
+            first[0].astype(jnp.int32),
+            jnp.int32(slot.position),
+            jnp.float32(req.temperature),
+        )
+        slot.dev_position = slot.position
+        slot.decoding = True
         self.prefills += 1
-        self._append_token(slot, first_id)
-
-    def _append_token(self, slot: Slot, token_id: int) -> None:
-        req = slot.request
-        req.generated.append(token_id)
-        self.tokens_generated += 1
-        done = len(req.generated) >= req.max_tokens or token_id == self.tokenizer.eos_id
-        if done:
-            self._finish(slot, pending_last=True)
+        try:
+            first.copy_to_host_async()
+        except Exception:
+            pass
+        self._readbacks.append(("first", slot, req, first, time.monotonic()))
 
     def _finish(self, slot: Slot, pending_last: bool) -> None:
         """``pending_last``: the final generated token was sampled but not yet
@@ -711,6 +835,21 @@ class LLMEngine:
         slot.request = None
         slot.last_used = time.monotonic()
         slot.pending_token = (req.generated[-1] if req.generated else None) if pending_last else None
+        if slot.decoding:
+            # park the lane: in-flight chunks keep decoding it (their tokens
+            # are skipped at processing — request identity mismatch) until
+            # this injection lands in dispatch order
+            slot.decoding = False
+            slot.dev_position = self.scratch_pos
+            self._dtok, self._dpos, self._dtemps = self._inject(
+                self._dtok,
+                self._dpos,
+                self._dtemps,
+                jnp.int32(slot.idx),
+                jnp.int32(0),
+                jnp.int32(self.scratch_pos),
+                jnp.float32(0.0),
+            )
         result = {
             "text": self.tokenizer.decode(req.generated),
             "tokens": req.generated,
@@ -720,45 +859,86 @@ class LLMEngine:
         }
         req.loop.call_soon_threadsafe(_resolve, req.future, result)
 
-    def _decode_step(self) -> None:
+    def _decode_dispatch(self) -> None:
+        """Dispatch one decode chunk chained on the device carry and queue
+        its token readback; processing happens a pipeline slot later."""
         chunk = self.decode_chunk
-        tokens = np.zeros((self.max_batch,), np.int32)
-        positions = np.full((self.max_batch,), self.scratch_pos, np.int32)
-        temps = np.zeros((self.max_batch,), np.float32)
-        active: list[Slot] = []
-        for slot in self.slots:
-            if slot.request is not None and not slot.pending_prompt:
-                tokens[slot.idx] = slot.request.generated[-1]
-                positions[slot.idx] = slot.position
-                temps[slot.idx] = slot.request.temperature
-                active.append(slot)
-        if not active:
+        snapshot = [
+            (s, s.request, s.dev_position)
+            for s in self.slots
+            if s.decoding and s.request is not None
+        ]
+        if not snapshot:
             return
         self._rng, key = jax.random.split(self._rng)
         keys = jax.random.split(key, chunk)
-        toks, self.cache = self._decode_n(
-            self.params,
-            self.cache,
-            jnp.asarray(tokens),
-            jnp.asarray(positions),
-            jnp.asarray(temps),
-            keys,
+        toks, self._dtok, self._dpos, self.cache = self._decode_n(
+            self.params, self.cache, self._dtok, self._dpos, self._dtemps, keys
         )
-        toks = np.asarray(toks)  # [chunk, B]
+        for s, _, _ in snapshot:
+            s.dev_position += chunk
         self.decode_steps += 1
-        self._occupancy_sum += len(active) / self.max_batch
-        # ITL = wall time between consecutive decode steps (including any
-        # interleaved prefill chunk) per generated token
+        self._occupancy_sum += len(snapshot) / self.max_batch
+        try:
+            toks.copy_to_host_async()
+        except Exception:
+            pass
+        self._readbacks.append(("chunk", snapshot, toks, time.monotonic()))
+
+    def _drain_readbacks(self, block: bool) -> None:
+        """Process landed readbacks in FIFO order. ``block`` forces the
+        OLDEST entry to completion (pipeline backpressure); later entries
+        are only consumed if their copies already landed."""
+        while self._readbacks:
+            entry = self._readbacks[0]
+            arr = entry[3] if entry[0] == "first" else entry[2]
+            if not block:
+                try:
+                    if not arr.is_ready():
+                        return
+                except Exception:
+                    return  # readiness not pollable: wait for a forced drain
+            self._readbacks.popleft()
+            if entry[0] == "first":
+                self._process_first(entry)
+            else:
+                self._process_chunk(entry)
+            block = False
+
+    def _process_first(self, entry) -> None:
+        _, slot, req, first, _ = entry
+        if slot.request is not req:
+            return  # request failed/superseded while the copy was in flight
+        first_id = int(np.asarray(first)[0])
+        req.ttft_ms = 1000 * (time.monotonic() - req.submitted_at)
+        self.ttft_ms_recent.append(req.ttft_ms)
+        req.generated.append(first_id)
+        self.tokens_generated += 1
+        if len(req.generated) >= req.max_tokens or first_id == self.tokenizer.eos_id:
+            # first token not yet in KV: carried into the next turn's prompt
+            self._finish(slot, pending_last=True)
+
+    def _process_chunk(self, entry) -> None:
+        _, snapshot, toks_dev, _ = entry
+        toks = np.asarray(toks_dev)  # [chunk, B]
+        chunk = toks.shape[0]
+        # ITL = wall time between consecutive chunk completions (including
+        # any interleaved prefill chunk) per generated token
         end = time.monotonic()
         if self._last_decode_end is not None:
             self.itl_ms_recent.append(1000 * (end - self._last_decode_end) / chunk)
         self._last_decode_end = end
         eos = self.tokenizer.eos_id
-        for slot in active:
-            req = slot.request
-            start = slot.position
-            remaining = req.max_tokens - len(req.generated)
+        for slot, req, start in snapshot:
+            if slot.request is not req:
+                continue  # finished in an earlier (lagged) entry
+            if not req.generated:
+                # first token's readback hasn't been processed yet (it sits
+                # later in the FIFO)? cannot happen: FIFO order guarantees
+                # the "first" entry precedes every chunk that continues it
+                continue
             outs = toks[:, slot.idx]
+            remaining = req.max_tokens - len(req.generated)
             used = 0
             hit_eos = False
             for j in range(min(chunk, remaining)):
@@ -768,6 +948,9 @@ class LLMEngine:
                     break
             req.generated.extend(int(t) for t in outs[:used])
             self.tokens_generated += used
+            # useful decode FLOPs only: overshoot tokens and parked lanes
+            # are real compute but wasted — MFU should show that, not hide it
+            self.flops_done += used * self.cfg.flops_per_token(start + used // 2)
             finished = hit_eos or len(req.generated) >= req.max_tokens
             if finished and used < chunk:
                 # chunk overshot: the used-th token was already fed at

@@ -37,8 +37,8 @@ class ModelConfig:
     def is_moe(self) -> bool:
         return self.n_experts > 0
 
-    def param_bytes(self, dtype_bytes: int = 2) -> int:
-        """Rough weight footprint for the HBM planner (bf16 default)."""
+    def param_count(self) -> int:
+        """Exact parameter count of models/llama.init_params' pytree."""
         embed = self.vocab_size * self.dim
         per_layer_attn = self.dim * self.dim + 2 * self.dim * (
             self.n_kv_heads * self.head_dim
@@ -47,7 +47,36 @@ class ModelConfig:
         if self.is_moe:
             ffn = self.n_experts * ffn + self.dim * self.n_experts
         per_layer = per_layer_attn + ffn + 2 * self.dim
-        return dtype_bytes * (2 * embed + self.n_layers * per_layer + self.dim)
+        return 2 * embed + self.n_layers * per_layer + self.dim
+
+    def param_bytes(self, dtype_bytes: int = 2) -> int:
+        """Rough weight footprint for the HBM planner (bf16 default)."""
+        return dtype_bytes * self.param_count()
+
+    def active_param_count(self) -> int:
+        """Params a single token's forward actually touches: for MoE only
+        ``experts_per_token`` of the expert FFNs contract with each token
+        (the engine's dense-einsum MoE still computes all experts on one
+        chip, but FLOP-utilization accounting follows the routed math)."""
+        if not self.is_moe:
+            return self.param_count()
+        full_ffn = 3 * self.dim * self.ffn_dim
+        unused = (self.n_experts - self.experts_per_token) * full_ffn
+        return self.param_count() - self.n_layers * unused
+
+    def flops_per_token(self, context_len: int) -> float:
+        """Forward-pass FLOPs to process ONE token with ``context_len``
+        tokens of attendable KV (matmul FLOPs = 2 × MACs; norms/rope/softmax
+        are O(d) noise and excluded). This is the per-step FLOP model MFU is
+        computed from (VERDICT r2 item 2): decode steps pass the current
+        sequence position, prefill passes the mean position of the chunk.
+        """
+        # every weight matmul: 2 FLOPs per weight actually contracted
+        matmul = 2.0 * self.active_param_count()
+        # attention scores + value combine: q·K^T and p·V, each
+        # 2 * heads * head_dim * context MACs → 4 FLOPs per context slot
+        attn = 4.0 * self.n_heads * self.head_dim * context_len
+        return matmul + self.n_layers * attn
 
 
 _REGISTRY: dict[str, ModelConfig] = {}

@@ -24,6 +24,17 @@ def main() -> None:
         force=True,
     )
     engine = os.environ.get("AGENTAINER_ENGINE", "echo")
+    # Honor JAX_PLATFORMS for real: the TPU-VM image's sitecustomize
+    # pre-imports jax pinned to the tunnel backend, so the env var alone is
+    # ignored by the time engine code runs — jax.config.update is what
+    # actually selects the platform (same trick as tests/conftest.py). A
+    # CPU-pinned control plane must spawn CPU engines, not engines that
+    # block on the one TPU session.
+    plat = os.environ.get("JAX_PLATFORMS", "")
+    if engine != "echo" and plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
     if engine == "echo":
         from ..engine.echo import serve
 

@@ -7,19 +7,40 @@ persisted through the control plane's store (the reference keeps them in
 Redis at ``agent:{AGENT_ID}:conversations`` trimmed to 50, app.py:50-68) so
 history survives an engine crash — this is BASELINE.json config #1 and the
 baseline workload for the proxy/journal benchmark.
+
+The HTTP layer is a hand-rolled ``asyncio.Protocol`` server rather than an
+aiohttp app: this engine IS the benchmark's inner loop, and on the 1-core
+control-plane hosts the framework targets, aiohttp's per-request parsing
+and response machinery was the single largest CPU consumer of the whole
+proxied-chat path. The protocol server parses Content-Length-framed
+HTTP/1.1 keepalive requests with two ``find`` calls and writes prebuilt
+response frames. (Chunked request bodies are not accepted — the native
+proxy always forwards with Content-Length.)
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
 import os
 import time
 
-from aiohttp import web
-
 from ..runtime.store_client import StoreClient
 
 MAX_TURNS = 50  # app.py:58 trim parity
+
+
+def _frame(status_reason: bytes, body: bytes) -> bytes:
+    return (
+        b"HTTP/1.1 " + status_reason + b"\r\nContent-Type: application/json\r\n"
+        b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+        b"Connection: keep-alive\r\n\r\n" + body
+    )
+
+
+_OK = b"200 OK"
+_BAD = b"400 Bad Request"
+_NF = b"404 Not Found"
 
 
 class EchoEngine:
@@ -39,41 +60,40 @@ class EchoEngine:
     def metrics_key(self) -> str:
         return f"agent:{self.agent_id}:metrics"
 
-    def app(self) -> web.Application:
-        app = web.Application()
-        app.router.add_get("/", self.h_root)
-        app.router.add_get("/health", self.h_health)
-        app.router.add_post("/chat", self.h_chat)
-        app.router.add_get("/history", self.h_history)
-        app.router.add_post("/clear", self.h_clear)
-        app.router.add_get("/metrics", self.h_metrics)
-        app.on_cleanup.append(lambda _app: self.store.close())
-        return app
-
-    async def h_root(self, request: web.Request) -> web.Response:
-        return web.json_response(
-            {
-                "agent": self.agent_name,
-                "engine": "echo",
-                "status": "running",
-                "endpoints": ["/health", "/chat", "/history", "/clear", "/metrics"],
-            }
+    # -- handlers (each returns a complete HTTP response frame) -----------
+    def h_root(self) -> bytes:
+        return _frame(
+            _OK,
+            json.dumps(
+                {
+                    "agent": self.agent_name,
+                    "engine": "echo",
+                    "status": "running",
+                    "endpoints": ["/health", "/chat", "/history", "/clear", "/metrics"],
+                }
+            ).encode(),
         )
 
-    async def h_health(self, request: web.Request) -> web.Response:
+    def h_health(self) -> bytes:
         self.requests_total += 1
-        return web.json_response(
-            {"status": "healthy", "agent_id": self.agent_id, "uptime_s": time.time() - self.started_at}
+        return _frame(
+            _OK,
+            json.dumps(
+                {
+                    "status": "healthy",
+                    "agent_id": self.agent_id,
+                    "uptime_s": time.time() - self.started_at,
+                }
+            ).encode(),
         )
 
-    async def h_chat(self, request: web.Request) -> web.Response:
+    async def h_chat(self, body: bytes) -> bytes:
         self.requests_total += 1
         self.chats_total += 1
         try:
-            body = await request.json()
-        except json.JSONDecodeError:
-            return web.json_response({"error": "invalid JSON"}, status=400)
-        message = str(body.get("message", ""))
+            message = str(json.loads(body).get("message", ""))
+        except (json.JSONDecodeError, AttributeError):
+            return _frame(_BAD, b'{"error": "invalid JSON"}')
         reply = f"Echo: {message}"
         now = time.time()
         try:
@@ -96,11 +116,14 @@ class EchoEngine:
             n = min(int(results[0]), 2 * MAX_TURNS)
         except Exception:
             n = -1  # store unreachable: still serve (availability over convo durability)
-        return web.json_response(
-            {"response": reply, "agent": self.agent_name, "conversation_length": n}
+        payload = (
+            b'{"response": ' + json.dumps(reply).encode()
+            + b', "agent": ' + json.dumps(self.agent_name).encode()
+            + b', "conversation_length": ' + str(n).encode() + b"}"
         )
+        return _frame(_OK, payload)
 
-    async def h_history(self, request: web.Request) -> web.Response:
+    async def h_history(self) -> bytes:
         self.requests_total += 1
         try:
             raw = await self.store.lrange(self.convo_key, 0, -1)
@@ -112,28 +135,187 @@ class EchoEngine:
                 turns.append(json.loads(item))
             except json.JSONDecodeError:
                 continue
-        return web.json_response({"history": turns, "count": len(turns)})
+        return _frame(_OK, json.dumps({"history": turns, "count": len(turns)}).encode())
 
-    async def h_clear(self, request: web.Request) -> web.Response:
+    async def h_clear(self) -> bytes:
         self.requests_total += 1
         try:
             await self.store.delete(self.convo_key)
         except Exception:
             pass
-        return web.json_response({"status": "cleared"})
+        return _frame(_OK, b'{"status": "cleared"}')
 
-    async def h_metrics(self, request: web.Request) -> web.Response:
-        return web.json_response(
-            {
-                "engine": "echo",
-                "requests_total": self.requests_total,
-                "chats_total": self.chats_total,
-                "uptime_s": time.time() - self.started_at,
-            }
+    def h_metrics(self) -> bytes:
+        return _frame(
+            _OK,
+            json.dumps(
+                {
+                    "engine": "echo",
+                    "requests_total": self.requests_total,
+                    "chats_total": self.chats_total,
+                    "uptime_s": time.time() - self.started_at,
+                }
+            ).encode(),
         )
+
+
+class _AccessLog:
+    """Batched access log: per-request lines cost one list append; a 200 ms
+    flusher writes them to stdout in one syscall. Keeps `logs --follow`
+    (docker logs -f parity) seeing per-request activity without paying a
+    write+flush syscall pair on every request."""
+
+    def __init__(self) -> None:
+        self.lines: list[str] = []
+        self._task: asyncio.Task | None = None
+
+    def start(self) -> None:
+        self._task = asyncio.ensure_future(self._flush_loop())
+
+    def add(self, method: bytes, path: bytes, status: int) -> None:
+        self.lines.append(
+            f"{time.strftime('%H:%M:%S')} access {method.decode('latin1')} "
+            f"{path.decode('latin1')} {status}\n"
+        )
+
+    async def _flush_loop(self) -> None:
+        import sys
+
+        while True:
+            await asyncio.sleep(0.2)
+            if self.lines:
+                batch, self.lines = self.lines, []
+                sys.stdout.write("".join(batch))
+                sys.stdout.flush()
+
+
+_access = _AccessLog()
+
+
+class _Conn(asyncio.Protocol):
+    """One keepalive connection. Requests are parsed from a byte buffer and
+    answered IN ORDER (async handlers chain on the previous response so a
+    pipelined client can't observe reordering)."""
+
+    __slots__ = ("eng", "tr", "buf", "chain")
+
+    def __init__(self, eng: EchoEngine):
+        self.eng = eng
+        self.tr = None
+        self.buf = b""
+        self.chain: asyncio.Future | None = None
+
+    def connection_made(self, transport) -> None:
+        self.tr = transport
+        try:
+            transport.get_extra_info("socket").setsockopt(
+                __import__("socket").IPPROTO_TCP, __import__("socket").TCP_NODELAY, 1
+            )
+        except Exception:
+            pass
+
+    def data_received(self, data: bytes) -> None:
+        self.buf += data
+        while True:
+            he = self.buf.find(b"\r\n\r\n")
+            if he < 0:
+                if len(self.buf) > (1 << 20):  # header flood guard
+                    self.tr.close()
+                return
+            head = self.buf[:he]
+            line_end = head.find(b"\r\n")
+            first = head if line_end < 0 else head[:line_end]
+            parts = first.split(b" ")
+            if len(parts) < 3:
+                self.tr.close()
+                return
+            method, target = parts[0], parts[1]
+            cl = 0
+            if line_end >= 0:
+                # anchor at a line start so X-Content-Length (or the value
+                # smuggled in the request target) can't desync the framing
+                lower = head[line_end:].lower()
+                idx = lower.find(b"\r\ncontent-length:")
+                if idx >= 0:
+                    end = lower.find(b"\r\n", idx + 2)
+                    try:
+                        cl = int(lower[idx + 17 : end if end >= 0 else None])
+                    except ValueError:
+                        self.tr.close()
+                        return
+            total = he + 4 + cl
+            if cl < 0 or cl > (64 << 20):
+                self.tr.close()
+                return
+            if len(self.buf) < total:
+                return
+            body = self.buf[he + 4 : total]
+            self.buf = self.buf[total:]
+            self._dispatch(method, target, body)
+
+    def _dispatch(self, method: bytes, target: bytes, body: bytes) -> None:
+        path = target.split(b"?", 1)[0]
+        eng = self.eng
+        # sync fast paths write immediately (no task) when nothing is queued
+        out: bytes | None = None
+        coro = None
+        if method == b"POST" and path == b"/chat":
+            coro = eng.h_chat(body)
+        elif path == b"/health":
+            out = eng.h_health()
+        elif path == b"/metrics":
+            out = eng.h_metrics()
+        elif path == b"/history":
+            coro = eng.h_history()
+        elif method == b"POST" and path == b"/clear":
+            coro = eng.h_clear()
+        elif path == b"/":
+            out = eng.h_root()
+        else:
+            out = _frame(_NF, b'{"error": "not found"}')
+        _access.add(method, path, 200 if out is None or out.startswith(b"HTTP/1.1 200") else 404)
+        if coro is None and self.chain is None:
+            self.tr.write(out)
+            return
+
+        prev = self.chain
+
+        async def run() -> None:
+            data = await coro if coro is not None else out
+            if prev is not None:
+                await prev
+            tr = self.tr
+            if tr is not None and not tr.is_closing():
+                tr.write(data)
+
+        task = asyncio.ensure_future(run())
+        self.chain = task
+        task.add_done_callback(self._chain_done)
+
+    def _chain_done(self, task) -> None:
+        if self.chain is task:
+            self.chain = None
+        if not task.cancelled() and task.exception() is not None and self.tr is not None:
+            self.tr.close()  # failed handler: don't leave the client hanging
+
+    def connection_lost(self, exc) -> None:
+        self.tr = None
 
 
 def serve() -> None:
     engine = EchoEngine()
     port = int(os.environ.get("AGENTAINER_PORT", "8000"))
-    web.run_app(engine.app(), host="127.0.0.1", port=port, print=None)
+
+    async def main() -> None:
+        loop = asyncio.get_running_loop()
+        _access.start()
+        server = await loop.create_server(lambda: _Conn(engine), "127.0.0.1", port)
+        try:
+            await server.serve_forever()
+        finally:
+            await engine.store.close()
+
+    try:
+        asyncio.run(main())
+    except KeyboardInterrupt:
+        pass

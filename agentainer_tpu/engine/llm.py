@@ -598,6 +598,11 @@ class LLMEngine:
             "batch_occupancy": round(self._occupancy_sum / max(1, self.decode_steps), 3),
             "ttft_ms_p50": round(recent[len(recent) // 2], 2) if recent else None,
             "itl_ms_p50": round(itl[len(itl) // 2], 2) if itl else None,
+            # raw append-ordered samples (bounded deques): lets a caller
+            # window percentiles over ITS measurement interval instead of
+            # whatever warmup/compile history the deque still holds
+            "ttft_samples": [round(x, 2) for x in self.ttft_ms_recent],
+            "itl_samples": [round(x, 2) for x in self.itl_ms_recent],
             "active_sessions": len(self.sessions),
             "max_batch": self.max_batch,
             "max_seq": self.max_seq,

@@ -35,6 +35,16 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", plat)
+    # Persistent XLA compilation cache (runtime/local.py points this at the
+    # daemon's data dir): a restarted engine reloads its compiled decode /
+    # prefill executables instead of recompiling, which is most of what
+    # crash-replay recovery time is made of on a 1-core host.
+    cache_dir = os.environ.get("AGENTAINER_COMPILE_CACHE", "")
+    if engine != "echo" and cache_dir:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     if engine == "echo":
         from ..engine.echo import serve
 

@@ -131,6 +131,9 @@ class LocalBackend(Backend):
                 "AGENTAINER_CHIPS": ",".join(map(str, chips)),
                 "AGENTAINER_CONTROL_URL": self.control_url,
                 "AGENTAINER_INTERNAL_TOKEN": engine_token,
+                # shared persistent XLA cache: a respawned engine loads its
+                # compiled executables instead of recompiling (recovery time)
+                "AGENTAINER_COMPILE_CACHE": str(self._dir / "jax_cache"),
             }
         )
         if agent.model.engine != "llm":

@@ -428,7 +428,43 @@ bool DataPlane::start() {
   accept_thread_ = std::thread([this] { accept_loop(listen_fd_, false); });
   if (uds_fd_ >= 0)
     uds_thread_ = std::thread([this] { accept_loop(uds_fd_, true); });
+  settle_thread_ = std::thread([this] { settle_loop(); });
   return true;
+}
+
+void DataPlane::settle_enqueue(std::function<void()> fn) {
+  bool inline_run = false;
+  {
+    std::lock_guard<std::mutex> lk(settle_mu_);
+    if (settle_stop_ || settle_q_.size() > 100000) {
+      // stopping or badly backed up: apply inline (backpressure) rather
+      // than drop — journal consistency over latency. The store I/O runs
+      // OUTSIDE the lock so overload doesn't serialize every conn thread.
+      inline_run = true;
+    } else {
+      settle_q_.push_back(std::move(fn));
+    }
+  }
+  if (inline_run) {
+    fn();
+    return;
+  }
+  settle_cv_.notify_one();
+}
+
+void DataPlane::settle_loop() {
+  std::unique_lock<std::mutex> lk(settle_mu_);
+  for (;;) {
+    settle_cv_.wait(lk, [this] { return settle_stop_ || !settle_q_.empty(); });
+    while (!settle_q_.empty()) {
+      auto fn = std::move(settle_q_.front());
+      settle_q_.pop_front();
+      lk.unlock();
+      fn();
+      lk.lock();
+    }
+    if (settle_stop_) return;
+  }
 }
 
 void DataPlane::stop() {
@@ -446,6 +482,14 @@ void DataPlane::stop() {
   // upstream) were just shutdown(), so blocked recvs return immediately.
   for (int i = 0; i < 500 && active_conns_.load() > 0; i++)
     std::this_thread::sleep_for(std::chrono::milliseconds(10));
+  // conn threads can no longer enqueue (settle_enqueue under settle_mu_ runs
+  // inline once settle_stop_ is set); drain what's queued, then join
+  {
+    std::lock_guard<std::mutex> lk(settle_mu_);
+    settle_stop_ = true;
+  }
+  settle_cv_.notify_one();
+  if (settle_thread_.joinable()) settle_thread_.join();
   if (!uds_path_.empty()) ::unlink(uds_path_.c_str());
 }
 
@@ -710,6 +754,10 @@ void DataPlane::handle_conn(int fd) {
         continue;
       }
 
+      // pending→processing BEFORE dispatch: the replay worker's 5 s tick
+      // re-dispatches PENDING entries of a running agent, so an in-flight
+      // generation longer than one tick would execute twice without this
+      // marker (journal.py stale-reclaim returns it to pending if we die)
       if (route.persist)
         store_set_at(store_, rec_key, record_json(e, "processing", 0, "", ""),
                      rec_deadline);
@@ -746,22 +794,31 @@ void DataPlane::handle_conn(int fd) {
             504, {}, envelope(false, "agent request failed; retry recorded", ""), keep);
       } else {
         if (route.persist) {
-          std::string resp_json = "{\"status_code\":" + std::to_string(up.status) +
-                                  ",\"headers\":{";
-          bool first = true;
-          for (const auto& kv : up.headers) {
-            if (!first) resp_json += ",";
-            first = false;
-            json_escape_to(resp_json, kv.first);
-            resp_json += ":";
-            json_escape_to(resp_json, kv.second);
-          }
-          resp_json += "},\"body_b64\":\"" +
-                       (up.body.empty() ? "" : b64_encode(up.body)) + "\"}";
-          store_set_at(store_, rec_key,
-                       record_json(e, "completed", 0, "", resp_json), rec_deadline);
-          store_lrem1(store_, "agent:" + agent_id + ":requests:pending", e.rid);
-          store_rpush(store_, "agent:" + agent_id + ":requests:completed", e.rid);
+          // settle off-path: archive the response + move pending→completed
+          // on the background thread. The client's response doesn't wait
+          // for archive I/O; the at-most-ms window where a replay tick
+          // could see a completed entry still pending is covered by engine
+          // idempotency (request-id memoization).
+          Store* store = store_;
+          settle_enqueue([store, e, agent_id, rec_key, rec_deadline, up]() {
+            std::string resp_json = "{\"status_code\":" +
+                                    std::to_string(up.status) + ",\"headers\":{";
+            bool first = true;
+            for (const auto& kv : up.headers) {
+              if (!first) resp_json += ",";
+              first = false;
+              json_escape_to(resp_json, kv.first);
+              resp_json += ":";
+              json_escape_to(resp_json, kv.second);
+            }
+            resp_json += "},\"body_b64\":\"" +
+                         (up.body.empty() ? "" : b64_encode(up.body)) + "\"}";
+            store_set_at(store, rec_key,
+                         record_json(e, "completed", 0, "", resp_json),
+                         rec_deadline);
+            store_lrem1(store, "agent:" + agent_id + ":requests:pending", e.rid);
+            store_rpush(store, "agent:" + agent_id + ":requests:completed", e.rid);
+          });
         }
         {
           std::lock_guard<std::mutex> lk(counter_mu_);

@@ -23,6 +23,9 @@
 #pragma once
 
 #include <atomic>
+#include <condition_variable>
+#include <deque>
+#include <functional>
 #include <mutex>
 #include <set>
 #include <string>
@@ -69,6 +72,13 @@ class DataPlane {
   void handle_uds_conn(int fd);
   void track(int fd, bool add);
 
+  // Deferred journal settles: the journal-BEFORE-dispatch write is the crash
+  // guarantee and stays on the request path; the completed-state transition
+  // is bookkeeping and runs on one background thread so its store ops and
+  // JSON serialization never add to request latency.
+  void settle_enqueue(std::function<void()> fn);
+  void settle_loop();
+
   Store* store_;
   std::string listen_host_;
   int listen_port_;
@@ -92,6 +102,12 @@ class DataPlane {
 
   std::mutex conn_mu_;
   std::set<int> conns_;
+
+  std::thread settle_thread_;
+  std::mutex settle_mu_;
+  std::condition_variable settle_cv_;
+  std::deque<std::function<void()>> settle_q_;
+  bool settle_stop_ = false;
 
   friend struct ConnCtx;
 };

@@ -185,8 +185,11 @@ class _AccessLog:
             await asyncio.sleep(0.2)
             if self.lines:
                 batch, self.lines = self.lines, []
-                sys.stdout.write("".join(batch))
-                sys.stdout.flush()
+                try:
+                    sys.stdout.write("".join(batch))
+                    sys.stdout.flush()
+                except Exception:
+                    pass  # broken log pipe: drop the batch, keep serving
 
 
 _access = _AccessLog()
@@ -273,8 +276,8 @@ class _Conn(asyncio.Protocol):
             out = eng.h_root()
         else:
             out = _frame(_NF, b'{"error": "not found"}')
-        _access.add(method, path, 200 if out is None or out.startswith(b"HTTP/1.1 200") else 404)
         if coro is None and self.chain is None:
+            _access.add(method, path, int(out[9:12]))
             self.tr.write(out)
             return
 
@@ -282,6 +285,7 @@ class _Conn(asyncio.Protocol):
 
         async def run() -> None:
             data = await coro if coro is not None else out
+            _access.add(method, path, int(data[9:12]))  # real handler status
             if prev is not None:
                 await prev
             tr = self.tr

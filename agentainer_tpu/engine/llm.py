@@ -405,16 +405,42 @@ class LLMEngine:
 
     def _build_compiled(self) -> None:
         cfg = self.cfg
-        # GSPMD cannot auto-partition a pallas_call: the Pallas kernels serve
-        # the single-chip path; meshed engines (tp/ep) use the einsum path
         use_flash = self.mesh is None
+        # Meshed engines can't let GSPMD partition a pallas_call, but
+        # attention is embarrassingly parallel over heads/batch — so tp/ep
+        # engines run the SAME flash kernels per device inside a shard_map
+        # body (parallel/flash_mesh.py). sp-sharded arenas stay on the
+        # einsum path (they need the partial-softmax combine XLA derives).
+        cache_attn_impl = None
+        if self.mesh is not None and self.sp == 1:
+            import os as _os
+
+            from ..parallel.flash_mesh import make_meshed_cache_attention, supported
+
+            force = _os.environ.get("ATPU_FORCE_MESH_FLASH", "")
+            on_tpu = jax.default_backend() == "tpu"
+            if supported(cfg, self.tp) and (on_tpu or force):
+                cache_attn_impl = make_meshed_cache_attention(
+                    self.mesh, interpret=not on_tpu
+                )
+            elif force:
+                # test hook: interpret-mode kernels don't need lane-aligned
+                # head_dim, so tiny CI configs exercise the meshed path too
+                cache_attn_impl = make_meshed_cache_attention(self.mesh, interpret=True)
+        self.meshed_flash = cache_attn_impl is not None
 
         def prefill(params, cache, slot, tokens, positions, n_real):
             # slice the slot's cache row, run the prompt, write the row back
             rowk = lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1)
             rowv = lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1)
             logits, row = forward(
-                params, cfg, tokens, positions, KVCache(rowk, rowv), use_flash=use_flash
+                params,
+                cfg,
+                tokens,
+                positions,
+                KVCache(rowk, rowv),
+                use_flash=use_flash,
+                cache_attn_impl=cache_attn_impl,
             )
             newk = lax.dynamic_update_slice_in_dim(cache.k, row.k, slot, axis=1)
             newv = lax.dynamic_update_slice_in_dim(cache.v, row.v, slot, axis=1)
@@ -436,7 +462,13 @@ class LLMEngine:
             def step(carry, key):
                 tok, pos, cache = carry
                 logits, cache = forward(
-                    params, cfg, tok[:, None], pos[:, None], cache, use_flash=use_flash
+                    params,
+                    cfg,
+                    tok[:, None],
+                    pos[:, None],
+                    cache,
+                    use_flash=use_flash,
+                    cache_attn_impl=cache_attn_impl,
                 )
                 nxt = sample(logits[:, 0], key, temperature=temps)
                 # clamp: parked (idle/finished) lanes decode forever at the
@@ -609,6 +641,7 @@ class LLMEngine:
             "tp": self.tp,
             "ep": self.ep,
             "sp": self.sp,
+            "meshed_flash": self.meshed_flash,
             # FLOP model + HBM telemetry: lifetime MFU here is a floor
             # (includes idle time); bench_llm.py samples flops_done twice
             # and computes windowed MFU over the loaded interval

@@ -124,6 +124,7 @@ def _attention_block(
     cv: jnp.ndarray | None,
     use_flash: bool,
     attn_impl=None,
+    cache_attn_impl=None,
 ):
     b, t, d = x.shape
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
@@ -138,7 +139,12 @@ def _attention_block(
         batch_idx = jnp.arange(b)[:, None]
         ck = ck.at[batch_idx, positions].set(k)
         cv = cv.at[batch_idx, positions].set(v)
-        attn = cache_attention(q, ck, cv, positions, use_pallas=use_flash)
+        if cache_attn_impl is not None:
+            # meshed engines: per-device Pallas flash via shard_map
+            # (parallel/flash_mesh.py) — GSPMD can't partition pallas_call
+            attn = cache_attn_impl(q, ck, cv, positions)
+        else:
+            attn = cache_attention(q, ck, cv, positions, use_pallas=use_flash)
     elif attn_impl is not None:
         # caller-supplied causal self-attention: the sequence-parallel
         # training path passes ring/Ulysses attention here (q/k/v are
@@ -160,6 +166,7 @@ def forward(
     cache: KVCache | None = None,
     use_flash: bool = True,
     attn_impl=None,
+    cache_attn_impl=None,
 ) -> tuple[jnp.ndarray, KVCache | None]:
     """Returns (logits [B, T, V], updated cache).
 
@@ -188,7 +195,10 @@ def forward(
         # dense, and XLA fuses the convert into the consuming matmuls
         lp = {k: dequant(v) for k, v in lp.items()}
         if cache is not None:
-            x, ck, cv = _attention_block(x, lp, cfg, positions, mask, ck, cv, use_flash)
+            x, ck, cv = _attention_block(
+                x, lp, cfg, positions, mask, ck, cv, use_flash,
+                cache_attn_impl=cache_attn_impl,
+            )
         else:
             x, _, _ = _attention_block(
                 x, lp, cfg, positions, mask, None, None, use_flash, attn_impl

@@ -221,14 +221,16 @@ async def _run_inner(services, backend, daemon_task) -> dict:
         recovery_ms = None
         sent = False
         if pid:
-            marker = f"did you survive {time.monotonic_ns()}?"
+            marker = ""
             t_kill = time.monotonic()
             os.kill(pid, signal.SIGKILL)
             # journaled request fired immediately after the kill: 202 (agent
             # already marked down) and 502 (dispatch hit the dead engine)
             # both leave the entry pending for replay; 200 means the kill
-            # raced a still-alive engine — retry until the journal has it
-            for _ in range(50):
+            # raced a still-alive engine — retry with a FRESH marker each
+            # attempt so a 200'd marker can't satisfy the history poll below
+            for attempt in range(50):
+                marker = f"did you survive {time.monotonic_ns()}-{attempt}?"
                 r = await _chat(session, aid, "recovery", marker, 8)
                 if r["status"] in (202, 502):
                     sent = True

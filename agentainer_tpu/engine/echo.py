@@ -284,7 +284,11 @@ class _Conn(asyncio.Protocol):
         prev = self.chain
 
         async def run() -> None:
-            data = await coro if coro is not None else out
+            try:
+                data = await coro if coro is not None else out
+            except BaseException:
+                _access.add(method, path, 500)  # failed handlers must log too
+                raise
             _access.add(method, path, int(data[9:12]))  # real handler status
             if prev is not None:
                 await prev

@@ -413,20 +413,11 @@ class LLMEngine:
         # einsum path (they need the partial-softmax combine XLA derives).
         cache_attn_impl = None
         if self.mesh is not None and self.sp == 1:
-            import os as _os
+            from ..parallel.flash_mesh import make_meshed_cache_attention, resolve_mesh_flash
 
-            from ..parallel.flash_mesh import make_meshed_cache_attention, supported
-
-            force = _os.environ.get("ATPU_FORCE_MESH_FLASH", "")
-            on_tpu = jax.default_backend() == "tpu"
-            if supported(cfg, self.tp) and (on_tpu or force):
-                cache_attn_impl = make_meshed_cache_attention(
-                    self.mesh, interpret=not on_tpu
-                )
-            elif force:
-                # test hook: interpret-mode kernels don't need lane-aligned
-                # head_dim, so tiny CI configs exercise the meshed path too
-                cache_attn_impl = make_meshed_cache_attention(self.mesh, interpret=True)
+            interp = resolve_mesh_flash(cfg, self.tp)
+            if interp is not None:
+                cache_attn_impl = make_meshed_cache_attention(self.mesh, interpret=interp)
         self.meshed_flash = cache_attn_impl is not None
 
         def prefill(params, cache, slot, tokens, positions, n_real):

@@ -20,8 +20,6 @@ exercises the identical shard_map + kernel path the TPU takes.
 
 from __future__ import annotations
 
-from functools import partial
-
 import functools as _functools
 
 from jax.sharding import Mesh, PartitionSpec as P
@@ -135,3 +133,22 @@ def supported(cfg, tp: int) -> bool:
         cfg.n_kv_heads % tp == 0
         and kernel_supported(cfg.n_heads // tp, cfg.n_kv_heads // tp, cfg.head_dim)
     )
+
+
+def resolve_mesh_flash(cfg, tp: int) -> bool | None:
+    """One policy for every meshed-flash call site (serve + train):
+    returns the ``interpret`` flag to build the shard_map kernels with, or
+    None when the meshed einsum path should be used instead. Compiled
+    kernels on TPU when the per-device shapes satisfy them;
+    ``ATPU_FORCE_MESH_FLASH`` forces interpret mode anywhere (CPU CI and
+    unsupported shapes exercise the identical shard_map path)."""
+    import os
+
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu and supported(cfg, tp):
+        return False
+    if os.environ.get("ATPU_FORCE_MESH_FLASH", ""):
+        return True
+    return None

@@ -84,21 +84,11 @@ def make_train_step(
         # non-sequence-parallel meshes: Pallas flash forward per device via
         # shard_map (reference-VJP backward) instead of the einsum path's
         # f32 [B,KV,G,T,S] score materialization (VERDICT r2 weak #2)
-        import os as _os
+        from .parallel.flash_mesh import make_trainable_causal_attention, resolve_mesh_flash
 
-        import jax as _jax
-
-        from .parallel.flash_mesh import make_trainable_causal_attention, supported
-
-        tp_size = int(mesh.shape.get("tp", 1))
-        on_tpu = _jax.default_backend() == "tpu"
-        force = _os.environ.get("ATPU_FORCE_MESH_FLASH", "")
-        if supported(cfg, tp_size) and on_tpu:
-            attn_impl = make_trainable_causal_attention(mesh, interpret=False)
-        elif force:
-            # test hook / unsupported shapes: interpret-mode kernels take any
-            # head_dim (same fallback llm.py uses)
-            attn_impl = make_trainable_causal_attention(mesh, interpret=True)
+        interp = resolve_mesh_flash(cfg, int(mesh.shape.get("tp", 1)))
+        if interp is not None:
+            attn_impl = make_trainable_causal_attention(mesh, interpret=interp)
     if sp > 1 and seq_attn != "none":
         if seq_attn == "auto":
             seq_attn = "ulysses" if cfg.n_kv_heads % sp == 0 else "ring"
@@ -122,21 +112,23 @@ def make_train_step(
 
         if cfg.n_layers % pp:
             raise ValueError(f"pp={pp} must divide n_layers={cfg.n_layers}")
-        # v0 pipelines compose only with dp (replicated tokens): a pp mesh
-        # with tp/sp/ep axes would silently replicate per-stage weights and
-        # skip the collective attention — refuse instead
-        others = {a: int(mesh.shape.get(a, 1)) for a in ("tp", "sp", "ep")}
+        # pp composes with dp (dp-sharded microbatch tokens) and tp
+        # (Megatron widths under GSPMD inside the partial-manual shard_map);
+        # sp/ep inside a pipeline stage remain future work — refuse rather
+        # than silently replicate
+        others = {a: int(mesh.shape.get(a, 1)) for a in ("sp", "ep")}
         if any(v > 1 for v in others.values()):
             raise ValueError(
                 f"pipeline parallelism does not compose with {others} yet; "
-                "use a dp×pp mesh"
+                "use a dp×tp×pp mesh"
             )
+        tp_size = int(mesh.shape.get("tp", 1))
         p_shard = jax.tree.map(
             lambda s: NamedSharding(mesh, s),
-            pipeline_param_specs(cfg.is_moe),
+            pipeline_param_specs(cfg.is_moe, tp=tp_size > 1),
             is_leaf=lambda x: isinstance(x, P),
         )
-        data = repl  # microbatches stream from replicated tokens (v0)
+        data = NamedSharding(mesh, P("dp", None))  # dp-sharded tokens
         compute_loss = make_pipeline_loss(cfg, mesh, n_microbatch)
     else:
         p_shard = param_shardings(mesh, moe=cfg.is_moe)

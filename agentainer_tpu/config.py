@@ -30,6 +30,7 @@ class SliceConfig:
     total_chips: int = 8
     hbm_per_chip: int = 16 * 1024**3
     name: str = "v5e-8"
+    hosts: int = 1  # multi-host slices: chips split evenly across hosts
 
 
 @dataclass
@@ -88,6 +89,7 @@ def load_config(path: str | None = None) -> Config:
     cfg.slice.total_chips = int(sl.get("total_chips", cfg.slice.total_chips))
     cfg.slice.hbm_per_chip = int(sl.get("hbm_per_chip", cfg.slice.hbm_per_chip))
     cfg.slice.name = sl.get("name", cfg.slice.name)
+    cfg.slice.hosts = int(sl.get("hosts", cfg.slice.hosts))
     feats = doc.get("features", {})
     cfg.features.request_persistence = bool(
         feats.get("request_persistence", cfg.features.request_persistence)
@@ -107,6 +109,8 @@ def load_config(path: str | None = None) -> Config:
     cfg.data_dir = env.get("ATPU_DATA_DIR", cfg.data_dir)
     if "ATPU_SLICE_CHIPS" in env:
         cfg.slice.total_chips = int(env["ATPU_SLICE_CHIPS"])
+    if "ATPU_SLICE_HOSTS" in env:
+        cfg.slice.hosts = int(env["ATPU_SLICE_HOSTS"])
     if "ATPU_REQUEST_PERSISTENCE" in env:
         cfg.features.request_persistence = env["ATPU_REQUEST_PERSISTENCE"].lower() in (
             "1",

@@ -81,16 +81,22 @@ def build_services(
         backend = LocalBackend(store=store)
     elif getattr(backend, "store", "absent") is None:
         backend.store = store  # LocalBackend built without a store: inject ours
+    # multi-host note: jax.distributed is joined by the ENGINE subprocesses
+    # (runtime/engine_main.py) — they run the JAX compute; the control-plane
+    # daemon must never block on the cluster barrier.
     topo = SliceTopology(
         total_chips=config.slice.total_chips,
         hbm_per_chip=config.slice.hbm_per_chip,
         name=config.slice.name,
+        hosts=config.slice.hosts,
     )
     scheduler = SliceScheduler(store, topo)
     manager = AgentManager(store, backend, scheduler)
     journal = RequestJournal(store)
     logs = LogPlane(store, data_dir=ddir, console=console_logs)
-    metrics = MetricsPlane(manager, store, interval_s=config.cadences.metrics_interval_s)
+    metrics = MetricsPlane(
+        manager, store, interval_s=config.cadences.metrics_interval_s, logs=logs
+    )
     backups = BackupManager(manager, store, ddir)
 
     services = Services(

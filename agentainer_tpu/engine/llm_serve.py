@@ -35,12 +35,29 @@ class LLMServeApp:
         self.config_name = os.environ.get("AGENTAINER_MODEL_CONFIG", "tiny")
         self.checkpoint = os.environ.get("AGENTAINER_CHECKPOINT", "")
         self.system_prompt = os.environ.get("AGENTAINER_SYSTEM_PROMPT", "")
+        # "assistant" flavor: the reference's SECOND example personality
+        # (examples/gemini-agent/app.py:87-113): a persona'd agent that
+        # FLATTENS its recent store-backed history into one prompt string
+        # per turn — stateless model calls, history-in-prompt — instead of
+        # the llm flavor's KV-resident sessions
+        self.flavor = os.environ.get("AGENTAINER_ENGINE", "llm")
+        self.flatten_history = self.flavor == "assistant"
+        self.history_turns = 3  # gemini-agent keeps the last 3 exchanges
         try:
             self.model_options = json.loads(
                 os.environ.get("AGENTAINER_MODEL_OPTIONS", "") or "{}"
             )
         except json.JSONDecodeError:
             self.model_options = {}
+        # deploy-time persona knobs (usable on the llm flavor too)
+        self.flatten_history = self.flatten_history or bool(
+            self.model_options.get("flatten_history")
+        )
+        self.history_turns = int(self.model_options.get("history_turns", self.history_turns))
+        if not self.system_prompt:
+            self.system_prompt = str(self.model_options.get("system_prompt", ""))
+        if self.flavor == "assistant" and not self.system_prompt:
+            self.system_prompt = "You are a helpful, concise assistant."
         self.chips = tuple(
             int(c) for c in os.environ.get("AGENTAINER_CHIPS", "0").split(",") if c != ""
         )
@@ -110,6 +127,7 @@ class LLMServeApp:
         app.router.add_get("/history", self.h_history)
         app.router.add_post("/clear", self.h_clear)
         app.router.add_get("/metrics", self.h_metrics)
+        app.router.add_post("/profile", self.h_profile)
 
         async def boot(app):
             async def load():
@@ -187,6 +205,28 @@ class LLMServeApp:
         max_tokens = int(body.get("max_tokens", 64))
         request_id = request.headers.get("X-Agentainer-Request-ID", "")
 
+        if self.flatten_history:
+            # gemini-agent-style turn: persona + last-N exchanges flattened
+            # into ONE prompt string, generated statelessly (no KV session)
+            prompt = await self._flattened_prompt(session, message)
+            result = await self.engine.generate(
+                prompt=prompt, max_tokens=max_tokens, request_id=request_id
+            )
+            await self._record_turn(session, message, result["text"])
+            return web.json_response(
+                {
+                    "response": result["text"],
+                    "agent": self.agent_name,
+                    "model": self.config_name,
+                    "persona": self.system_prompt,
+                    "usage": {
+                        "prompt_tokens": result["prompt_tokens"],
+                        "completion_tokens": result["completion_tokens"],
+                    },
+                    "ttft_ms": result.get("ttft_ms"),
+                }
+            )
+
         # crash-resume: an unknown session may have a KV snapshot in the
         # store from a previous engine life — restore it before generating
         # so the conversation continues from its exact context
@@ -215,18 +255,7 @@ class LLMServeApp:
             task = asyncio.ensure_future(self._snapshot_session(session))
             self._bg_tasks.add(task)  # an unreferenced task can be GC'd mid-flight
             task.add_done_callback(self._bg_tasks.discard)
-        now = time.time()
-        try:
-            await self.store.rpush(
-                self.convo_key,
-                json.dumps({"role": "user", "content": message, "ts": now, "session": session}),
-                json.dumps(
-                    {"role": "assistant", "content": result["text"], "ts": now, "session": session}
-                ),
-            )
-            await self.store.ltrim(self.convo_key, -2 * MAX_TURNS, -1)
-        except Exception:
-            pass
+        await self._record_turn(session, message, result["text"])
         return web.json_response(
             {
                 "response": result["text"],
@@ -239,6 +268,47 @@ class LLMServeApp:
                 "ttft_ms": result.get("ttft_ms"),
             }
         )
+
+    async def _record_turn(self, session: str, message: str, reply: str) -> None:
+        now = time.time()
+        try:
+            await self.store.rpush(
+                self.convo_key,
+                json.dumps({"role": "user", "content": message, "ts": now, "session": session}),
+                json.dumps(
+                    {"role": "assistant", "content": reply, "ts": now, "session": session}
+                ),
+            )
+            await self.store.ltrim(self.convo_key, -2 * MAX_TURNS, -1)
+        except Exception:
+            pass
+
+    async def _flattened_prompt(self, session: str, message: str) -> str:
+        """Persona + the session's last ``history_turns`` exchanges as one
+        prompt string (examples/gemini-agent/app.py:87-113 parity)."""
+        lines: list[str] = []
+        try:
+            # full (ltrim-bounded) list, filtered by session BEFORE windowing
+            # — a fixed tail window would let a busy concurrent session evict
+            # this one's history from the prompt
+            raw = await self.store.lrange(self.convo_key, 0, -1)
+        except Exception:
+            raw = []
+        turns = []
+        for item in raw:
+            try:
+                t = json.loads(item)
+            except json.JSONDecodeError:
+                continue
+            if t.get("session", "default") == session:
+                turns.append(t)
+        for t in turns[-2 * self.history_turns :]:
+            who = "User" if t.get("role") == "user" else "Assistant"
+            lines.append(f"{who}: {t.get('content', '')}")
+        lines.append(f"User: {message}")
+        lines.append("Assistant:")
+        history = "\n".join(lines)
+        return f"{self.system_prompt}\n\n{history}" if self.system_prompt else history
 
     async def h_generate(self, request: web.Request) -> web.Response:
         """Raw completion endpoint (no conversation memory)."""
@@ -285,6 +355,52 @@ class LLMServeApp:
         if self.engine is not None:
             await asyncio.to_thread(self.engine.clear_sessions)
         return web.json_response({"status": "cleared"})
+
+    async def h_profile(self, request: web.Request) -> web.Response:
+        """Capture a jax.profiler trace of live serving (device + host
+        timelines). One capture at a time; the trace directory is shared
+        with the control plane so the management API can return its path."""
+        self.requests_total += 1
+        err = await self._ensure_engine()
+        if err is not None:
+            return err
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            body = {}  # empty/absent body → defaults
+        if not isinstance(body, dict):
+            body = {}
+        try:
+            duration = min(float(body.get("duration_s", 2.0) or 2.0), 60.0)
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": 'duration_s must be a number, e.g. {"duration_s": 2.0}'},
+                status=400,
+            )
+        if getattr(self, "_profiling", False):
+            return web.json_response({"error": "profile already running"}, status=409)
+        trace_dir = os.environ.get("AGENTAINER_PROFILE_DIR", "") or os.path.join(
+            "/tmp", f"atpu-profile-{self.agent_id}"
+        )
+        os.makedirs(trace_dir, exist_ok=True)
+        self._profiling = True
+        try:
+            import jax
+
+            jax.profiler.start_trace(trace_dir)
+            try:
+                await asyncio.sleep(duration)
+            finally:
+                jax.profiler.stop_trace()
+        except Exception as e:
+            return web.json_response(
+                {"error": f"profiler failed: {type(e).__name__}: {e}"}, status=500
+            )
+        finally:
+            self._profiling = False
+        return web.json_response(
+            {"trace_dir": trace_dir, "duration_s": duration, "agent_id": self.agent_id}
+        )
 
     async def h_metrics(self, request: web.Request) -> web.Response:
         doc = {

@@ -134,11 +134,11 @@ class AgentManager:
 
     def _validate_model(self, ref: ModelRef) -> None:
         """Image-exists validation parity (agent.go:106 ImageInspectWithRaw)."""
-        from ..engine import known_engines
+        from ..engine import is_tpu_engine, known_engines
 
         if ref.engine not in known_engines():
             raise InvalidInput(f"unknown engine {ref.engine!r}; known: {sorted(known_engines())}")
-        if ref.engine == "llm":
+        if is_tpu_engine(ref.engine):
             from ..models.configs import get_config
 
             try:
@@ -164,7 +164,10 @@ class AgentManager:
         """Create-or-start, parity with agent.go:154-164."""
         info = self.backend.engine_info(agent.engine_id) if agent.engine_id else None
         if info is None:
-            share_group = agent.model.config if agent.model.engine == "llm" else ""
+            from ..engine import is_tpu_engine
+
+            # JAX-backed flavors sharing a model config share weight HBM
+            share_group = agent.model.config if is_tpu_engine(agent.model.engine) else ""
             placement = self.scheduler.allocate(agent, share_group=share_group)
             agent.engine_id = self.backend.create_engine(agent, placement.chips)
         self.backend.start_engine(agent.engine_id)

@@ -24,38 +24,49 @@ def main() -> None:
         force=True,
     )
     engine = os.environ.get("AGENTAINER_ENGINE", "echo")
-    # Honor JAX_PLATFORMS for real: the TPU-VM image's sitecustomize
-    # pre-imports jax pinned to the tunnel backend, so the env var alone is
-    # ignored by the time engine code runs — jax.config.update is what
-    # actually selects the platform (same trick as tests/conftest.py). A
-    # CPU-pinned control plane must spawn CPU engines, not engines that
-    # block on the one TPU session.
-    plat = os.environ.get("JAX_PLATFORMS", "")
-    if engine != "echo" and plat:
-        import jax
+    from ..engine import is_tpu_engine
 
-        jax.config.update("jax_platforms", plat)
-    # Persistent XLA compilation cache (runtime/local.py points this at the
-    # daemon's data dir): a restarted engine reloads its compiled decode /
-    # prefill executables instead of recompiling, which is most of what
-    # crash-replay recovery time is made of on a 1-core host.
-    cache_dir = os.environ.get("AGENTAINER_COMPILE_CACHE", "")
-    if engine != "echo" and cache_dir:
-        import jax
+    if is_tpu_engine(engine):
+        # Honor JAX_PLATFORMS for real: the TPU-VM image's sitecustomize
+        # pre-imports jax pinned to the tunnel backend, so the env var alone
+        # is ignored by the time engine code runs — jax.config.update is
+        # what actually selects the platform (same trick as
+        # tests/conftest.py). A CPU-pinned control plane must spawn CPU
+        # engines, not engines that block on the one TPU session.
+        plat = os.environ.get("JAX_PLATFORMS", "")
+        if plat:
+            import jax
 
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    if engine == "echo":
-        from ..engine.echo import serve
+            jax.config.update("jax_platforms", plat)
+        # Persistent XLA compilation cache (runtime/local.py points this at
+        # the daemon's data dir): a restarted engine reloads its compiled
+        # decode/prefill executables instead of recompiling, which is most
+        # of what crash-replay recovery time is made of on a 1-core host.
+        cache_dir = os.environ.get("AGENTAINER_COMPILE_CACHE", "")
+        if cache_dir:
+            import jax
 
-        serve()
-    elif engine == "llm":
-        from ..engine.llm_serve import serve
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        # Multi-host: the ENGINE processes are the ones running JAX compute,
+        # so they are what joins the jax.distributed cluster (one TPU engine
+        # per host, ATPU_DIST_* set by the operator/scheduler). The control
+        # plane never blocks on the cluster barrier.
+        from ..parallel.dcn import init_distributed
 
-        serve()
-    else:
+        try:
+            init_distributed()
+        except Exception as e:
+            print(f"[engine] jax.distributed init failed: {e}", file=sys.stderr)
+    import importlib
+
+    from ..engine import engine_registry
+
+    module = engine_registry().get(engine)
+    if module is None:
         print(f"unknown engine {engine!r}", file=sys.stderr)
         sys.exit(2)
+    importlib.import_module(module).serve()
 
 
 if __name__ == "__main__":

@@ -134,9 +134,13 @@ class LocalBackend(Backend):
                 # shared persistent XLA cache: a respawned engine loads its
                 # compiled executables instead of recompiling (recovery time)
                 "AGENTAINER_COMPILE_CACHE": str(self._dir / "jax_cache"),
+                # jax.profiler captures land here (POST /agents/{id}/profile)
+                "AGENTAINER_PROFILE_DIR": str(self._dir / "profiles" / agent.id),
             }
         )
-        if agent.model.engine != "llm":
+        from ..engine import is_tpu_engine
+
+        if not is_tpu_engine(agent.model.engine):
             # non-TPU engines must not grab the TPU runtime — clear both the
             # platform selector and the axon-tunnel trigger the TPU-VM image
             # injects via sitecustomize

@@ -71,6 +71,10 @@ class SliceTopology:
     hbm_per_chip: int = HBM_PER_CHIP_V5E
     name: str = "v5e-8"
     mesh_shape: tuple[int, int] = (2, 4)  # (rows, cols)
+    # multi-host slices (e.g. v5e-16 = 2 hosts × 8 chips): chip ids are
+    # row-major with each host owning a contiguous run; placements that fit
+    # one host stay on ICI, cross-host spans pay DCN (parallel/dcn.py)
+    hosts: int = 1
 
     def __post_init__(self) -> None:
         rows, cols = self.mesh_shape
@@ -80,6 +84,20 @@ class SliceTopology:
             r = max(d for d in range(1, int(self.total_chips**0.5) + 1)
                     if self.total_chips % d == 0)
             self.mesh_shape = (r, self.total_chips // r)
+        if self.hosts < 1 or self.total_chips % self.hosts:
+            raise ValueError(
+                f"hosts={self.hosts} must divide total_chips={self.total_chips}"
+            )
+
+    @property
+    def chips_per_host(self) -> int:
+        return self.total_chips // self.hosts
+
+    def host_of(self, chip: int) -> int:
+        return chip // self.chips_per_host
+
+    def spans_hosts(self, chips: tuple[int, ...]) -> bool:
+        return len({self.host_of(c) for c in chips}) > 1
 
     def windows(self, n: int) -> list[tuple[int, ...]]:
         """Candidate ICI-adjacent chip sets of size n, preference-ordered.
@@ -117,6 +135,11 @@ class SliceTopology:
             out = [
                 tuple(range(s, s + n)) for s in range(self.total_chips - n + 1)
             ]
+        # host-aware preference: windows inside one host's ICI domain rank
+        # ahead of ones whose collectives would cross DCN (stable sort
+        # keeps the squareness ordering within each class)
+        if self.hosts > 1:
+            out.sort(key=self.spans_hosts)
         return out
 
 

@@ -193,6 +193,7 @@ class ControlPlaneApp:
         r.add_get("/agents/{agent_id}/logs", self.h_logs)
         r.add_get("/agents/{agent_id}/requests", self.h_requests)
         r.add_post("/agents/{agent_id}/requests/{request_id}/replay", self.h_manual_replay)
+        r.add_post("/agents/{agent_id}/profile", self.h_profile)
         r.add_get("/agents/{agent_id}/health", self.h_agent_health)
         r.add_get("/agents/{agent_id}/metrics", self.h_agent_metrics)
         r.add_get("/agents/{agent_id}/metrics/history", self.h_agent_metrics_history)
@@ -376,6 +377,32 @@ class ControlPlaneApp:
             {"request_id": request_id, "status_code": status, "body": body.decode("utf-8", "replace")},
             message="Request replayed",
         )
+
+    async def h_profile(self, request: web.Request) -> web.Response:
+        """Capture a jax.profiler trace on the agent's engine (SURVEY §5.1:
+        the reference had only a logging middleware; profiling is a
+        first-class requirement here). Body: {"duration_s": N ≤ 60}. The
+        trace lands under the daemon's data dir; the response carries the
+        path for tensorboard / xprof."""
+        agent_id = request.match_info["agent_id"]
+        try:
+            agent = self.s.manager.get_agent(agent_id)
+        except AgentNotFound:
+            return fail(f"agent not found: {agent_id}", status=404)
+        if agent.status != AgentStatus.RUNNING:
+            return fail("agent is not running", status=409)
+        body = await request.read()
+        status, _, resp_body = await self.dispatch_to_agent(
+            agent_id, "POST", "/profile", {"Content-Type": "application/json"}, body
+        )
+        if status in (DISPATCH_ENGINE_GONE, DISPATCH_FAILED):
+            return fail("engine unreachable for profiling", status=502)
+        self._audit(request, "profile", agent_id, "success" if status == 200 else "failed")
+        try:
+            doc = json.loads(resp_body)
+        except json.JSONDecodeError:
+            doc = {"raw": resp_body.decode("utf-8", "replace")}
+        return ok(doc) if status == 200 else fail(str(doc), status=status)
 
     async def h_agent_health(self, request: web.Request) -> web.Response:
         agent_id = request.match_info["agent_id"]
@@ -709,14 +736,20 @@ class ControlPlaneApp:
             # non-crash failure (timeout, protocol error): retry accounting
             # ran; the entry dead-letters after MAX_RETRIES
             return fail("agent request failed; retry recorded", status=504)
+        out_headers = {
+            k: v
+            for k, v in resp_headers.items()
+            if k.lower() not in _HOP_BY_HOP and k.lower() != "content-type"
+        }
+        if request_id:
+            # span continuity: the journal id IS the trace span — the caller
+            # can correlate its response with /agents/{id}/requests and the
+            # engine's own logs (SURVEY §5.1 tracing requirement)
+            out_headers[REQUEST_ID_HEADER] = request_id
         return web.Response(
             status=status,
             body=resp_body,
-            headers={
-                k: v
-                for k, v in resp_headers.items()
-                if k.lower() not in _HOP_BY_HOP and k.lower() != "content-type"
-            },
+            headers=out_headers,
             content_type=(resp_headers.get("Content-Type", "application/octet-stream").split(";")[0]),
         )
 

@@ -827,6 +827,10 @@ void DataPlane::handle_conn(int fd) {
           c.lat_sum += dt;
           c.lat_max = std::max(c.lat_max, dt);
         }
+        if (route.persist)
+          // span continuity: the journal id rides back to the caller so a
+          // response correlates with /agents/{id}/requests + engine logs
+          up.headers.emplace_back("X-Agentainer-Request-ID", e.rid);
         resp_raw = build_response(
             up.status, up.headers, up.body, keep,
             req.method == "HEAD" ? up.header("content-length") : "");

@@ -82,15 +82,26 @@ def test_native_proxy_end_to_end(tmp_path):
                 f"/agent/{aid}/chat", data=json.dumps({"message": "native hello"})
             )
             assert resp.status == 200, await resp.text()
+            # span continuity from the C++ proxy: journal id in the response
+            span = resp.headers.get("X-Agentainer-Request-ID", "")
+            assert span
             doc = await resp.json()
             assert doc["response"] == "Echo: native hello"
             assert doc["conversation_length"] == 2
 
-            # journal visible through the Python management API
-            resp = await session.get(f"/agents/{aid}/requests?status=completed", headers=AUTH)
-            reqs = (await resp.json())["data"]
+            # journal visible through the Python management API (the settle
+            # is deferred to a background thread — allow it a beat)
+            for _ in range(50):
+                resp = await session.get(
+                    f"/agents/{aid}/requests?status=completed", headers=AUTH
+                )
+                reqs = (await resp.json())["data"]
+                if reqs["stats"]["completed"]:
+                    break
+                await asyncio.sleep(0.05)
             assert reqs["stats"]["completed"] == 1
             assert reqs["stats"]["pending"] == 0
+            assert reqs["requests"][0]["id"] == span
             rec = reqs["requests"][0]
             assert rec["method"] == "POST"
             assert rec["path"] == "/chat"

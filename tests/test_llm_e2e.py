@@ -74,6 +74,32 @@ def test_llm_agent_end_to_end(tmp_path):
             assert doc["ttft_ms"] is not None
             assert isinstance(doc["response"], str)
 
+            # span continuity: the response carries the journal id, and that
+            # id settles as a COMPLETED journal entry (proxy → journal →
+            # engine → response headers, SURVEY §5.1)
+            span = resp.headers.get("X-Agentainer-Request-ID", "")
+            assert span, dict(resp.headers)
+            entry = services.journal.get(agent["id"], span)
+            assert entry is not None and entry.status == "completed"
+
+            # jax.profiler capture through the management plane
+            resp = await client.post(
+                f"/agents/{agent['id']}/profile",
+                json={"duration_s": 0.3},
+                headers=AUTH,
+            )
+            assert resp.status == 200, await resp.text()
+            prof = (await resp.json())["data"]
+            import os as _os
+
+            assert _os.path.isdir(prof["trace_dir"])
+            captured = [
+                _os.path.join(r, f)
+                for r, _, fs in _os.walk(prof["trace_dir"])
+                for f in fs
+            ]
+            assert captured, f"no trace files under {prof['trace_dir']}"
+
             # second turn, same session: history durable in the control plane
             resp = await client.post(
                 f"/agent/{agent['id']}/chat",
@@ -98,6 +124,13 @@ def test_llm_agent_end_to_end(tmp_path):
             stats = services.backend.stats(services.manager.get_agent(agent["id"]).engine_id)
             assert stats["tokens_generated"] >= 16
             assert stats["ttft_ms_p50"] is not None
+
+            # HBM telemetry: the metrics plane audits the engine's reported
+            # footprint against the scheduler's claim (VERDICT r2 weak #6)
+            sample = services.metrics.sample_agent(agent["id"])
+            assert sample["engine"]["param_hbm_bytes"] > 0
+            assert sample["hbm"]["engine_reported_bytes"] > 0
+            assert sample["hbm"]["over_reservation"] is False
         finally:
             backend.close()
             await client.close()

@@ -1,0 +1,92 @@
+"""Serve-time sequence parallelism: verify the COLLECTIVE SHAPE, not just
+the numerics (VERDICT r2 weak #4 — "sp trusts GSPMD blindly").
+
+With the KV arena's sequence axis sharded over sp, decode attention must
+lower to per-chip partial softmax (local max/sum-exp + tiny all-reduces)
+and a partial output contraction — NOT an all-gather of the KV shard,
+which would silently erase the memory win sp exists for. These tests
+compile the real attention computation under an sp mesh and assert on the
+HLO text: every all-gather (if any) is small control traffic, never the
+cache shard; at least one cross-sp reduction exists.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from agentainer_tpu.ops.attention import attention_reference, cache_mask
+from agentainer_tpu.parallel.mesh import make_mesh
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="needs the virtual multi-device mesh"
+)
+
+B, S, KV, G, HD = 2, 64, 2, 2, 16
+H = KV * G
+SHARD_ELEMS = B * S * KV * HD // 2  # one chip's cache shard
+
+
+def _op_result_elems(line: str) -> int:
+    """Element count of the first shaped result on an HLO text line."""
+    m = re.search(r"=\s+\w+\[([0-9,]*)\]", line)
+    if not m or not m.group(1):
+        return 0
+    n = 1
+    for d in m.group(1).split(","):
+        n *= int(d)
+    return n
+
+
+def _compile_decode(sp: int):
+    mesh = make_mesh(sp, sp=sp)
+    cache_sh = NamedSharding(mesh, P(None, "sp", None, None))
+    repl = NamedSharding(mesh, P())
+    k = jax.device_put(jnp.ones((B, S, KV, HD), jnp.float32), cache_sh)
+    v = jax.device_put(jnp.ones((B, S, KV, HD), jnp.float32), cache_sh)
+    q = jax.device_put(jnp.ones((B, 1, H, HD), jnp.float32), repl)
+    pos = jax.device_put(jnp.full((B, 1), 40, jnp.int32), repl)
+
+    def decode_attn(q, k, v, pos):
+        return attention_reference(q, k, v, mask=cache_mask(pos, S))
+
+    lowered = jax.jit(decode_attn).lower(q, k, v, pos)
+    return lowered.compile().as_text()
+
+
+def test_sp_decode_reduces_instead_of_gathering_kv():
+    hlo = _compile_decode(2)
+    gathers = [ln for ln in hlo.splitlines() if "all-gather" in ln and "=" in ln]
+    big = [ln for ln in gathers if _op_result_elems(ln) >= SHARD_ELEMS]
+    assert not big, f"sp decode all-gathers the KV shard:\n" + "\n".join(big)
+    reduces = [
+        ln
+        for ln in hlo.splitlines()
+        if ("all-reduce" in ln or "reduce-scatter" in ln) and "=" in ln
+    ]
+    assert reduces, "no cross-sp reduction found — sharding was dropped?"
+
+
+def test_sp_decode_numerics_match_unsharded():
+    mesh = make_mesh(2, sp=2)
+    cache_sh = NamedSharding(mesh, P(None, "sp", None, None))
+    repl = NamedSharding(mesh, P())
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 3)
+    k = jax.random.normal(ks[0], (B, S, KV, HD), jnp.float32)
+    v = jax.random.normal(ks[1], (B, S, KV, HD), jnp.float32)
+    q = jax.random.normal(ks[2], (B, 1, H, HD), jnp.float32)
+    pos = jnp.full((B, 1), 40, jnp.int32)
+    want = attention_reference(q, k, v, mask=cache_mask(pos, S))
+
+    ks_ = jax.device_put(k, cache_sh)
+    vs_ = jax.device_put(v, cache_sh)
+    qs_ = jax.device_put(q, repl)
+    ps_ = jax.device_put(pos, repl)
+    got = jax.jit(lambda q, k, v, p: attention_reference(q, k, v, mask=cache_mask(p, S)))(
+        qs_, ks_, vs_, ps_
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)

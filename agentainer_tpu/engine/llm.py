@@ -356,10 +356,24 @@ class LLMEngine:
         else:
             devices = list(all_devices[:n_use])
 
+        synthetic = bool(options.get("synthetic"))
         if checkpoint:
             from .checkpoint import load_params
 
             params = load_params(cfg, checkpoint, dtype=dtype)  # host-side
+        elif synthetic and quant:
+            # benchmark-grade int8 weights generated directly in HBM: no
+            # minutes-long host init, no multi-GB host→device transfer
+            if n_use > 1:
+                raise ValueError(
+                    "synthetic init is single-device only (meshed engines "
+                    "need sharded generation — load a checkpoint instead)"
+                )
+            from .quant import synthetic_quantized_params
+
+            params = synthetic_quantized_params(
+                cfg, dtype, device=devices[0] if devices else None
+            )
         elif quant:
             # random init on the HOST when quantizing: the dense bf16 model
             # may be exactly what doesn't fit the chip
@@ -374,10 +388,11 @@ class LLMEngine:
                 params = init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
         else:
             params = init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
-        if quant:
+        if quant and not (synthetic and not checkpoint):
             from .quant import quantize_params
 
-            # host-side: only the int8 model ever reaches HBM
+            # host-side: only the int8 model ever reaches HBM (synthetic
+            # init already produced QTensors in device memory)
             params = quantize_params(params, dtype)
         max_batch = int(options.get("max_batch", 8))
         # long-context default scales with sp: the sharded arena holds

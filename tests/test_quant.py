@@ -131,3 +131,33 @@ def test_tp_clamps_to_assigned_chips():
         assert used == {2, 3}, used
     finally:
         engine.shutdown()
+
+
+def test_synthetic_int8_engine_generates():
+    """Device-side synthetic int8 init: QTensor weights generated in device
+    memory (no host init / transfer), engine serves normally."""
+    from agentainer_tpu.engine.llm import LLMEngine
+    from agentainer_tpu.ops.quant import QTensor
+
+    engine = LLMEngine.create(
+        "tiny", options={"quant": "int8", "synthetic": True, "max_batch": 2, "max_seq": 128}
+    )
+    try:
+        assert isinstance(engine.params["layers"]["wq"], QTensor)
+        assert engine.params["layers"]["wq"].q.dtype.name == "int8"
+        assert isinstance(engine.params["embed"], QTensor)
+        result = asyncio.run(engine.generate("synthetic", max_tokens=6))
+        assert result["completion_tokens"] == 6
+    finally:
+        engine.shutdown()
+
+
+def test_synthetic_refuses_meshed():
+    import pytest as _pytest
+
+    from agentainer_tpu.engine.llm import LLMEngine
+
+    with _pytest.raises(ValueError, match="single-device"):
+        LLMEngine.create(
+            "tiny", options={"quant": "int8", "synthetic": True, "tp": 2, "max_batch": 2}
+        )

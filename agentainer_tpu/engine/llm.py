@@ -115,11 +115,13 @@ class LLMEngine:
         tp: int = 1,
         ep: int = 1,
         sp: int = 1,
+        pp: int = 1,
         devices: list | None = None,
     ):
         self.cfg = cfg
         self.tokenizer = tokenizer
         self.max_batch = max_batch
+        self.pp = max(1, pp)
         self.sp = max(1, sp)
         # the sequence axis must split evenly over sp chips
         max_seq = ((max_seq + self.sp - 1) // self.sp) * self.sp
@@ -134,7 +136,36 @@ class LLMEngine:
         self.scratch_pos = max_seq - 1  # idle-slot write target; never generated into
         dtype = params["final_norm"].dtype  # always dense, even when quantized
         cache_shape = (cfg.n_layers, max_batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
-        if self.tp * self.ep * self.sp > 1:
+        self._pp_forward = None
+        if self.pp > 1:
+            # serve-time pipeline: layer stack AND the KV arena stage over
+            # pp — each chip holds L/pp layers' weights plus L/pp of the
+            # cache, so a model deeper than one chip's HBM serves at all
+            # (parallel/pipeline.make_serve_pipeline_forward)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.mesh import make_mesh
+            from ..parallel.pipeline import (
+                make_serve_pipeline_forward,
+                pipeline_param_specs,
+            )
+
+            self.mesh = make_mesh(self.pp, pp=self.pp, devices=devices)
+            p_sh = jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s),
+                pipeline_param_specs(cfg.is_moe),
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            params = jax.device_put(params, p_sh)
+            cache_sh = NamedSharding(self.mesh, P("pp", None, None, None, None))
+            cache = jax.jit(
+                lambda: KVCache(
+                    jnp.zeros(cache_shape, dtype), jnp.zeros(cache_shape, dtype)
+                ),
+                out_shardings=KVCache(cache_sh, cache_sh),
+            )()
+            self._pp_forward = make_serve_pipeline_forward(cfg, self.mesh)
+        elif self.tp * self.ep * self.sp > 1:
             # serve-time model parallelism over the agent's ASSIGNED chips:
             # Megatron-style GSPMD shardings on a tp×ep mesh — heads/FFN
             # width split over tp, MoE expert weights split over ep (each
@@ -245,7 +276,7 @@ class LLMEngine:
             x.nbytes for x in jax.tree.leaves(params)
         )
         self.kv_arena_bytes = cache.k.nbytes + cache.v.nbytes
-        self._n_chips = self.tp * self.ep * self.sp
+        self._n_chips = self.tp * self.ep * self.sp * self.pp
         self._chip = chip_spec((devices or jax.devices() or [None])[0])
         self._peak_flops = self._chip.bf16_flops * self._n_chips
 
@@ -301,6 +332,7 @@ class LLMEngine:
         tp_asked = int(options.get("tp", 0) or 0)
         ep_asked = int(options.get("ep", 0) or 0)
         sp_asked = int(options.get("sp", 0) or 0)
+        pp_asked = int(options.get("pp", 0) or 0)
         # chip budget: an explicit chip assignment is the placement
         # authority — tp×sp×ep may only narrow the span, never spill onto
         # chips owned by other agents; standalone (no assignment) spans
@@ -310,8 +342,39 @@ class LLMEngine:
         else:
             budget = min(
                 len(all_devices),
-                max(1, tp_asked) * max(1, ep_asked) * max(1, sp_asked),
+                max(1, tp_asked) * max(1, ep_asked) * max(1, sp_asked) * max(1, pp_asked),
             )
+        if pp_asked > 1:
+            # serve-time pipeline: layers + arena staged over pp (v0
+            # composes with nothing else — one axis, whole assignment)
+            if tp_asked or ep_asked or sp_asked:
+                raise ValueError("serve-time pp does not compose with tp/ep/sp yet")
+            if quant:
+                raise ValueError("serve-time pp does not support quantized weights yet")
+            pp = min(pp_asked, budget)
+            if cfg.n_layers % pp or cfg.vocab_size % pp:
+                raise ValueError(
+                    f"pp={pp} must divide n_layers={cfg.n_layers} and "
+                    f"vocab={cfg.vocab_size}"
+                )
+            if chips and len(chips) >= pp and all(c < len(all_devices) for c in chips):
+                devices = [all_devices[c] for c in chips[:pp]]
+            else:
+                devices = list(all_devices[:pp])
+            params = init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+            engine = cls(
+                cfg,
+                params,
+                tokenizer,
+                max_batch=int(options.get("max_batch", 8)),
+                max_seq=int(options.get("max_seq", min(cfg.max_seq_len, 2048))),
+                decode_chunk=int(options.get("decode_chunk", 8)),
+                prefill_chunk=int(options.get("prefill_chunk", 256)),
+                pp=pp,
+                devices=devices,
+            )
+            engine.warmup()
+            return engine
         # sequence parallelism is opt-in (long-context serving); requested
         # sp reserves its chips before the tp/ep split
         model_budget = max(1, budget // max(1, sp_asked))
@@ -427,7 +490,7 @@ class LLMEngine:
         # body (parallel/flash_mesh.py). sp-sharded arenas stay on the
         # einsum path (they need the partial-softmax combine XLA derives).
         cache_attn_impl = None
-        if self.mesh is not None and self.sp == 1:
+        if self.mesh is not None and self.sp == 1 and self.pp == 1:
             from ..parallel.flash_mesh import make_meshed_cache_attention, resolve_mesh_flash
 
             interp = resolve_mesh_flash(cfg, self.tp)
@@ -435,19 +498,27 @@ class LLMEngine:
                 cache_attn_impl = make_meshed_cache_attention(self.mesh, interpret=interp)
         self.meshed_flash = cache_attn_impl is not None
 
+        pp_forward = self._pp_forward
+
+        def run_forward(params, toks, pos, cache):
+            if pp_forward is not None:
+                logits, k, v = pp_forward(params, toks, pos, cache.k, cache.v)
+                return logits, KVCache(k, v)
+            return forward(
+                params,
+                cfg,
+                toks,
+                pos,
+                cache,
+                use_flash=use_flash,
+                cache_attn_impl=cache_attn_impl,
+            )
+
         def prefill(params, cache, slot, tokens, positions, n_real):
             # slice the slot's cache row, run the prompt, write the row back
             rowk = lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1)
             rowv = lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1)
-            logits, row = forward(
-                params,
-                cfg,
-                tokens,
-                positions,
-                KVCache(rowk, rowv),
-                use_flash=use_flash,
-                cache_attn_impl=cache_attn_impl,
-            )
+            logits, row = run_forward(params, tokens, positions, KVCache(rowk, rowv))
             newk = lax.dynamic_update_slice_in_dim(cache.k, row.k, slot, axis=1)
             newv = lax.dynamic_update_slice_in_dim(cache.v, row.v, slot, axis=1)
             last = lax.dynamic_slice_in_dim(logits, n_real - 1, 1, axis=1)[0, 0]
@@ -467,15 +538,7 @@ class LLMEngine:
 
             def step(carry, key):
                 tok, pos, cache = carry
-                logits, cache = forward(
-                    params,
-                    cfg,
-                    tok[:, None],
-                    pos[:, None],
-                    cache,
-                    use_flash=use_flash,
-                    cache_attn_impl=cache_attn_impl,
-                )
+                logits, cache = run_forward(params, tok[:, None], pos[:, None], cache)
                 nxt = sample(logits[:, 0], key, temperature=temps)
                 # clamp: parked (idle/finished) lanes decode forever at the
                 # scratch position — real lanes never reach it (admission

@@ -211,3 +211,118 @@ def make_pipeline_loss(cfg: ModelConfig, mesh: Mesh, n_microbatch: int | None = 
         )
 
     return loss
+
+
+# ---------------------------------------------------------------------------
+# serve-time pipeline: prefill/decode with the layer stack AND the KV arena
+# staged over pp (SURVEY §2.3 lists PP as a first-class serve mechanism; the
+# training pipeline above reorders compute, this one distributes SERVING
+# state — each chip holds L/pp layers' weights and L/pp of the cache, so a
+# model deeper than one chip's HBM serves at all).
+# ---------------------------------------------------------------------------
+
+
+def _apply_stage_cached(x, lp_stack, cfg: ModelConfig, positions, ck, cv):
+    """This stage's local layers against its local arena rows (same scan
+    body as models/llama.forward, over L/pp layers)."""
+
+    def step(x, inputs):
+        lp, ckl, cvl = inputs
+        lp = {k: dequant(v) for k, v in lp.items()}
+        x, ckl, cvl = _attention_block(x, lp, cfg, positions, None, ckl, cvl, False)
+        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + (_moe_mlp(h, lp, cfg) if cfg.is_moe else _mlp(h, lp))
+        return x, (ckl, cvl)
+
+    x, (ck, cv) = lax.scan(step, x, (lp_stack, ck, cv))
+    return x, ck, cv
+
+
+def make_serve_pipeline_forward(cfg: ModelConfig, mesh: Mesh):
+    """``fn(params, tokens, positions, cache_k, cache_v) → (logits, k, v)``
+    with layers + arena staged over pp.
+
+    v0 semantics: one in-flight activation (no microbatch overlap — decode
+    is latency-bound anyway); every stage computes every tick in SPMD form
+    and masked selects keep only the active stage's activation and cache
+    writes, so correctness needs no data-dependent control flow. Embed and
+    the LM head vocab-shard over pp like the training pipeline; the final
+    hidden state is masked-psum broadcast off the last stage and logits
+    all-gather over the vocab axis (small next to activations).
+    """
+    pp = int(mesh.shape["pp"])
+    if cfg.n_layers % pp:
+        raise ValueError(f"pp={pp} must divide n_layers={cfg.n_layers}")
+    if cfg.vocab_size % pp:
+        raise ValueError(f"vocab {cfg.vocab_size} must divide by pp={pp}")
+    vshard = cfg.vocab_size // pp
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+    layer_specs = pipeline_layer_specs(cfg.is_moe)
+    cache_spec = P("pp", None, None, None, None)
+
+    def local(layers_local, embed, final_norm, lm_head, tokens, positions, ck, cv):
+        stage = lax.axis_index("pp")
+        base = stage * vshard
+        # distributed embedding (vocab shards over pp, one psum)
+        emb_l = embed_lookup(embed, jnp.clip(tokens - base, 0, vshard - 1))
+        in_shard = ((tokens >= base) & (tokens < base + vshard))[..., None]
+        x = lax.psum(jnp.where(in_shard, emb_l, 0), "pp")  # [B,T,D]
+        # carries become per-stage ("varying") the moment they meet the
+        # staged cache/layers — mark them so the scan types line up
+        state = lax.pcast(x, ("pp",), to="varying")
+        h_final = lax.pcast(jnp.zeros_like(x), ("pp",), to="varying")
+        for t in range(pp):
+            new_state, nck, ncv = _apply_stage_cached(
+                state, layers_local, cfg, positions, ck, cv
+            )
+            keep = stage == t
+            ck = jnp.where(keep, nck, ck)
+            cv = jnp.where(keep, ncv, cv)
+            if t == pp - 1:
+                # the pipeline's real output lives on the last stage now:
+                # broadcast it (masked psum) for the shared logits below
+                h_final = lax.psum(
+                    jnp.where(stage == pp - 1, new_state, jnp.zeros_like(new_state)),
+                    "pp",
+                )
+            out_state = jnp.where(keep, new_state, state)
+            state = lax.ppermute(out_state, "pp", perm)
+        h = rms_norm(h_final, final_norm, cfg.norm_eps)
+        logits_local = (h @ dequant(lm_head)).astype(jnp.float32)  # [B,T,V/pp]
+        logits = lax.all_gather(logits_local, "pp", axis=2, tiled=True)  # [B,T,V]
+        return logits, ck, cv
+
+    sharded = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            layer_specs,
+            P("pp", None),
+            P(None),
+            P(None, "pp"),
+            P(),
+            P(),
+            cache_spec,
+            cache_spec,
+        ),
+        out_specs=(P(), cache_spec, cache_spec),
+        axis_names={"pp"},
+        # logits are value-replicated by construction (masked psum +
+        # all_gather) but typed "varying" — no varying→invariant cast
+        # exists, so the vma check is disabled for this map
+        check_vma=False,
+    )
+
+    def fn(params, tokens, positions, cache_k, cache_v):
+        return sharded(
+            params["layers"],
+            params["embed"],
+            params["final_norm"],
+            params["lm_head"],
+            tokens,
+            positions,
+            cache_k,
+            cache_v,
+        )
+
+    return fn

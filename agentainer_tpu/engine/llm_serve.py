@@ -5,8 +5,8 @@ examples/gpt-agent/app.py:32-179): /chat /health /history /clear /metrics.
 The serving stack inside this process:
 
     aiohttp handlers → continuous-batching scheduler (engine/llm.py)
-        → JAX model (models/llama.py | models/mixtral.py) on the chips
-          assigned by the slice scheduler (AGENTAINER_CHIPS)
+        → JAX model (models/llama.py; MoE configs via cfg.is_moe) on the
+          chips assigned by the slice scheduler (AGENTAINER_CHIPS)
 
 Conversation turns persist through the control plane's store (crash-durable);
 the KV-cache can be checkpointed there too (engine/checkpoint.py) so a
@@ -130,13 +130,24 @@ class LLMServeApp:
         app.router.add_post("/profile", self.h_profile)
 
         async def boot(app):
-            async def load():
-                try:
-                    await asyncio.to_thread(self._load_engine)
-                finally:
-                    self._ready.set()  # set even on loader death: waiters unblock
+            # DAEMON thread, not asyncio.to_thread: executor threads are
+            # joined at interpreter exit, so a load blocked in the TPU
+            # runtime (wedged tunnel) would make SIGTERM hang until the
+            # backend escalates to SIGKILL — the exact kill that wedges the
+            # single-client tunnel for everyone after us. A daemon loader
+            # lets a terminated engine die cleanly mid-load.
+            import threading
 
-            app["loader"] = asyncio.create_task(load())
+            loop = asyncio.get_running_loop()
+
+            def _run() -> None:
+                try:
+                    self._load_engine()
+                finally:
+                    # set even on loader death: waiters unblock
+                    loop.call_soon_threadsafe(self._ready.set)
+
+            threading.Thread(target=_run, daemon=True, name="model-loader").start()
 
         async def cleanup(app):
             if self.engine is not None:
@@ -371,7 +382,10 @@ class LLMServeApp:
         if not isinstance(body, dict):
             body = {}
         try:
-            duration = min(float(body.get("duration_s", 2.0) or 2.0), 60.0)
+            # clamp below the control plane's 30 s dispatch timeout: a trace
+            # the proxy can't wait out would 502 the caller while the engine
+            # completed it anyway (ADVICE r3)
+            duration = min(float(body.get("duration_s", 2.0) or 2.0), 25.0)
         except (TypeError, ValueError):
             return web.json_response(
                 {"error": 'duration_s must be a number, e.g. {"duration_s": 2.0}'},
@@ -409,6 +423,7 @@ class LLMServeApp:
             "requests_total": self.requests_total,
             "uptime_s": time.time() - self.started_at,
             "model_loaded": self.engine is not None,
+            "engine_error": self.engine_error or None,
             "kv_snapshots": self.kv_snapshots,
             "kv_restores": self.kv_restores,
         }

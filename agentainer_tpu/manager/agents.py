@@ -211,7 +211,15 @@ class AgentManager:
                 self._start_engine(agent)
             elif agent.status == AgentStatus.RUNNING:
                 info = agent.engine_id and self.backend.engine_info(agent.engine_id)
-                if not info or info.state != EngineState.RUNNING:
+                # probe too: a just-SIGKILL'd process reports running for a
+                # beat (exit not reapable yet) while its socket already
+                # refuses — trusting engine_info alone would no-op resume on
+                # a mid-crash agent and return success for a dead engine
+                if (
+                    not info
+                    or info.state != EngineState.RUNNING
+                    or not self.backend.probe_engine(agent.engine_id)
+                ):
                     self._start_engine(agent)  # crashed-but-not-yet-reconciled
                 else:
                     return agent

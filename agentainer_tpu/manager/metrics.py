@@ -121,12 +121,16 @@ class MetricsPlane:
             # the claim was never validated against reality)
             engine = sample.get("engine") or {}
             used = engine.get("hbm_bytes_per_chip_est")
-            claimed = placement.hbm_bytes
-            if used is not None and claimed:
-                over = used > claimed
+            # placement.hbm_bytes is the agent's TOTAL reservation; the
+            # engine reports PER-CHIP usage — compare per-chip to per-chip
+            # (ADVICE r3: the mismatched units made the audit miss exactly
+            # the multi-chip over-reservations it exists to catch)
+            claimed_per_chip = placement.hbm_bytes // max(1, len(placement.chips))
+            if used is not None and claimed_per_chip:
+                over = used > claimed_per_chip
                 sample["hbm"] = {
-                    "claimed_bytes": claimed,
-                    "engine_reported_bytes": used,
+                    "claimed_bytes_per_chip": claimed_per_chip,
+                    "engine_reported_bytes_per_chip": used,
                     "over_reservation": over,
                 }
                 # latch: warn once per false→true transition, not every 10 s
@@ -136,7 +140,7 @@ class MetricsPlane:
                     self.logs.warn(
                         "metrics",
                         f"agent {agent_id} engine reports {used} HBM bytes/chip "
-                        f"over its {claimed}-byte reservation",
+                        f"over its {claimed_per_chip}-byte per-chip reservation",
                         agent_id=agent_id,
                     )
         self.store.set_json(Keys.metrics_current(agent_id), sample, ttl=METRICS_CURRENT_TTL_S)

@@ -92,6 +92,18 @@ class Backend(ABC):
         ContainerStats analogue, collector.go:228)."""
         return None
 
+    def probe_engine(self, engine_id: str) -> bool:
+        """Liveness beyond process state: does the engine actually answer?
+
+        A SIGKILL'd process can report running for a beat (the exit status
+        isn't reapable yet) while its socket already refuses connections —
+        resume() must not trust engine_info alone or it no-ops on an agent
+        that is mid-crash and returns success for a dead engine. Default:
+        trust engine_info (backends without an HTTP surface).
+        """
+        info = self.engine_info(engine_id)
+        return info is not None and info.state == EngineState.RUNNING
+
     def subscribe_events(self, callback: Callable[[str, EngineState], None]) -> Callable[[], None]:
         """Push-based engine state changes (docker event stream analogue).
 

@@ -57,7 +57,11 @@ def main() -> None:
         try:
             init_distributed()
         except Exception as e:
+            # Loud failure (ADVICE r3): an engine explicitly configured to
+            # join a multi-host cluster must not silently serve a local-only
+            # topology the operator believes spans hosts.
             print(f"[engine] jax.distributed init failed: {e}", file=sys.stderr)
+            sys.exit(3)
     import importlib
 
     from ..engine import engine_registry

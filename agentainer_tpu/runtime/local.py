@@ -166,7 +166,16 @@ class LocalBackend(Backend):
             rec = self._require(engine_id)
             if rec.proc is not None and rec.proc.poll() is None:
                 rec.desired_running = True
-                return
+                if self._probe(rec.port):
+                    return  # genuinely alive and answering
+                # poll() lies for a beat after a SIGKILL (exit status not
+                # reapable yet) while the port already refuses: give the
+                # kernel a moment to settle, then respawn if it's dead
+                deadline = time.time() + 3.0
+                while time.time() < deadline and rec.proc.poll() is None:
+                    time.sleep(0.05)
+                if rec.proc.poll() is None:
+                    return  # alive but unresponsive: not ours to double-spawn
             self._spawn(rec)
             rec.desired_running = True
         self._wait_ready(rec)
@@ -194,8 +203,6 @@ class LocalBackend(Backend):
         """Block until the engine answers /health (containers have no such
         gate in the reference; engines do because JAX init takes seconds and
         a 'started' engine should be servable)."""
-        import http.client
-
         deadline = time.time() + self.ready_timeout_s
         while time.time() < deadline:
             if rec.proc is None or rec.proc.poll() is not None:
@@ -203,15 +210,9 @@ class LocalBackend(Backend):
                     f"engine {rec.engine_id} exited during startup; "
                     f"log: {self._tail_log(rec, 20)}"
                 )
-            try:
-                conn = http.client.HTTPConnection("127.0.0.1", rec.port, timeout=1.0)
-                conn.request("GET", "/health")
-                if conn.getresponse().status == 200:
-                    conn.close()
-                    return
-                conn.close()
-            except OSError:
-                time.sleep(0.05)
+            if self._probe(rec.port, timeout=1.0):
+                return
+            time.sleep(0.05)
         raise RuntimeError(f"engine {rec.engine_id} not ready after {self.ready_timeout_s}s")
 
     def stop_engine(self, engine_id: str, timeout_s: float = 10.0) -> None:
@@ -342,6 +343,30 @@ class LocalBackend(Backend):
             return data
         except (OSError, ValueError):
             return None
+
+    def probe_engine(self, engine_id: str) -> bool:
+        """Real liveness: the engine answers /health. Process state alone
+        lies for a beat after SIGKILL (poll() still None while the port
+        already refuses) — resume() uses this to decide rehydration."""
+        with self._lock:
+            rec = self._recs.get(engine_id)
+            if rec is None or rec.proc is None or rec.paused:
+                return False
+            port = rec.port
+        return self._probe(port)
+
+    @staticmethod
+    def _probe(port: int, timeout: float = 2.0) -> bool:
+        import http.client
+
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+            conn.request("GET", "/health")
+            ok = conn.getresponse().status == 200
+            conn.close()
+            return ok
+        except OSError:
+            return False
 
     def subscribe_events(self, callback: Callable[[str, EngineState], None]) -> Callable[[], None]:
         self._listeners.append(callback)

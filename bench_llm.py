@@ -12,11 +12,18 @@ traffic, and reports:
   recovery_ms               — SIGKILL mid-traffic → first replayed
                               response served (BASELINE's second metric)
 
-Model selection: $ATPU_BENCH_MODEL (default "bench-1b", a 1.1 B-param
-Llama-style config that random-inits quickly; "llama3-8b" with
-$ATPU_BENCH_QUANT=int8 is the full-size flagship when the round budget
-allows its host-side init). The label is embedded in the output — a
-bench-1b number is never passed off as an 8B number.
+Model selection is TIERED so a bare `python bench.py` (how the driver runs
+it) always produces a number: each tier deploys, waits a bounded time for
+the model to load, and on timeout tears the engine down and falls back to
+the next smaller config. Weights default to synthetic int8 generated
+directly in HBM (engine/quant.synthetic_quantized_params) — seconds to
+load instead of minutes of host init + multi-GB transfer; perf doesn't
+care what the weights ARE. The served model label is embedded in the
+output, so a fallback number is never passed off as the flagship's.
+
+Env overrides: ATPU_BENCH_MODEL pins a single config (with
+ATPU_BENCH_QUANT / ATPU_BENCH_SYNTHETIC / ATPU_BENCH_DEADLINE), otherwise
+the default ladder is llama3-8b+int8 → bench-1b+int8.
 
 Runs standalone (`python bench_llm.py`) or embedded via `run()` from
 bench.py. Requires a JAX device (the engine subprocess uses the real
@@ -37,13 +44,44 @@ import time
 SESSIONS = int(os.environ.get("ATPU_BENCH_SESSIONS", "8"))
 TURNS = int(os.environ.get("ATPU_BENCH_TURNS", "6"))
 MAX_TOKENS = int(os.environ.get("ATPU_BENCH_MAX_TOKENS", "64"))
-MODEL = os.environ.get("ATPU_BENCH_MODEL", "bench-1b")
-QUANT = os.environ.get("ATPU_BENCH_QUANT", "")
+RECOVERY_DEADLINE_S = float(os.environ.get("ATPU_BENCH_RECOVERY_DEADLINE", "600"))
 PROMPT = (
     "You are a helpful assistant running on a TPU. Summarize the following: "
     "the quick brown fox jumps over the lazy dog, again and again, while the "
     "control plane journals every request so that a crash never loses one. "
 )
+
+
+def _tiers() -> list[dict]:
+    """The model ladder. ATPU_BENCH_MODEL pins a single tier; the default
+    ladder tries the flagship first and falls back to the 1B config so a
+    slow/wedged load degrades to a smaller LABELED number, not an error."""
+    synthetic = os.environ.get("ATPU_BENCH_SYNTHETIC", "1") != "0"
+    raw = os.environ.get("ATPU_BENCH_TIERS", "")
+    if raw:  # full ladder override, JSON: [{"model":..,"quant":..,"deadline_s":..}]
+        tiers = json.loads(raw)
+        for t in tiers:
+            t.setdefault("quant", "int8")
+            t.setdefault("synthetic", synthetic)
+            t.setdefault("deadline_s", 600.0)
+        return tiers
+    model = os.environ.get("ATPU_BENCH_MODEL", "")
+    if model:
+        return [
+            {
+                "model": model,
+                # int8-synthetic by default even when pinned: the bench has
+                # no checkpoint, so weights are random either way — generate
+                # them quantized in HBM instead of minutes of host init
+                "quant": os.environ.get("ATPU_BENCH_QUANT", "int8"),
+                "synthetic": synthetic,
+                "deadline_s": float(os.environ.get("ATPU_BENCH_DEADLINE", "900")),
+            }
+        ]
+    return [
+        {"model": "llama3-8b", "quant": "int8", "synthetic": synthetic, "deadline_s": 600.0},
+        {"model": "bench-1b", "quant": "int8", "synthetic": synthetic, "deadline_s": 300.0},
+    ]
 
 
 def log(msg: str) -> None:
@@ -74,11 +112,11 @@ async def run() -> dict:
     cfg.auth_token = "bench-token"
     cfg.server.host = "127.0.0.1"
     cfg.server.port = 0
-    backend = LocalBackend(data_dir=tmp, ready_timeout_s=1200.0)
+    backend = LocalBackend(data_dir=tmp, ready_timeout_s=120.0)
     services = build_services(config=cfg, backend=backend, console_logs=False, data_dir=tmp)
     daemon_task = asyncio.create_task(run_daemon(services))
     try:
-        return await _run_inner(services, backend, daemon_task)
+        return await _run_tiers(services, backend, daemon_task)
     finally:
         # ALWAYS tear down: a failed bench must not leak the daemon or an
         # engine subprocess holding the TPU chip
@@ -90,7 +128,7 @@ async def run() -> dict:
             pass
 
 
-async def _run_inner(services, backend, daemon_task) -> dict:
+async def _run_tiers(services, backend, daemon_task) -> dict:
     for _ in range(200):
         if services.public_port or daemon_task.done():
             break
@@ -101,164 +139,226 @@ async def _run_inner(services, backend, daemon_task) -> dict:
     import aiohttp
 
     auth = {"Authorization": "Bearer bench-token"}
-    options: dict = {"max_batch": SESSIONS, "max_seq": 1024}
-    if QUANT:
-        options["quant"] = QUANT
-        # no checkpoint → weights are random either way; generate them int8
-        # directly in HBM (seconds) instead of minutes of host init
-        if os.environ.get("ATPU_BENCH_SYNTHETIC", "1") != "0":
-            options["synthetic"] = True
-    t_deploy = time.monotonic()
+    attempts: list[dict] = []
     async with aiohttp.ClientSession(
         f"http://127.0.0.1:{services.public_port}",
         timeout=aiohttp.ClientTimeout(total=1800),
     ) as session:
-        resp = await session.post(
-            "/agents",
-            json={
-                "name": "bench-llm",
-                "model": {"engine": "llm", "config": MODEL, "options": options},
-            },
-            headers=auth,
-        )
-        doc = await resp.json()
-        assert doc.get("success"), doc
-        agent = doc["data"]
-        aid = agent["id"]
-        resp = await session.post(f"/agents/{aid}/start", headers=auth)
-        assert resp.status == 200, await resp.text()
+        for tier in _tiers():
+            try:
+                llm = await _run_tier(session, auth, backend, tier, attempts)
+            except Exception as e:  # noqa: BLE001 - fall down the ladder
+                attempts.append({"tier": dict(tier), "error": f"{type(e).__name__}: {e}"})
+                log(f"tier {tier['model']} failed: {type(e).__name__}: {e}")
+                continue
+            if llm is not None:
+                if attempts:
+                    llm["fallback_from"] = attempts
+                return llm
+    # every tier failed: return the partial telemetry instead of raising —
+    # bench.py embeds this verbatim so the round's artifact still says what
+    # happened on the hardware (VERDICT r3 weak #1)
+    return {"error": "all bench tiers failed to load", "attempts": attempts}
 
-        # wait until the model is actually loaded (engine answers 503 with a
-        # loading marker until then; the journal queues those). Bounded: a
-        # load that dies (OOM, bad config) must fail the LLM bench, not hang
-        # it — bench.py still reports the primary proxy metric either way.
-        load_deadline = time.monotonic() + 1500
-        while True:
-            m = await _metrics(session, aid)
-            if m.get("model_loaded"):
-                break
-            if time.monotonic() > load_deadline:
-                raise RuntimeError(f"model load timed out; last /metrics: {m}")
-            await asyncio.sleep(2.0)
-        load_s = time.monotonic() - t_deploy
-        log(f"model {MODEL}{'+'+QUANT if QUANT else ''} loaded in {load_s:.0f}s")
 
-        # warmup: one full-length turn + one follow-up per session, so every
-        # prefill bucket the measured turns will hit is already compiled and
-        # the engine's TTFT histogram reflects steady-state serving
-        await asyncio.gather(
-            *(_chat(session, aid, f"w{i}", PROMPT, 8) for i in range(SESSIONS))
-        )
-        await asyncio.gather(
-            *(
-                _chat(session, aid, f"w{i}", "Turn 0: tell me more about it.", 8)
-                for i in range(SESSIONS)
+async def _agent_teardown(session, auth, aid: str) -> None:
+    """Stop + remove a failed tier's agent and WAIT for the engine process
+    to exit — the axon TPU tunnel is single-client, so the next tier's
+    engine cannot even initialize until this one is gone."""
+    try:
+        await session.post(f"/agents/{aid}/stop", headers=auth)
+    except Exception:
+        pass
+    try:
+        await session.delete(f"/agents/{aid}", headers=auth)
+    except Exception:
+        pass
+
+
+async def _run_tier(session, auth, backend, tier: dict, attempts: list) -> dict | None:
+    model, quant = tier["model"], tier["quant"]
+    options: dict = {"max_batch": SESSIONS, "max_seq": 1024}
+    if quant:
+        options["quant"] = quant
+        if tier.get("synthetic"):
+            options["synthetic"] = True
+    t_deploy = time.monotonic()
+    resp = await session.post(
+        "/agents",
+        json={
+            "name": f"bench-llm-{model}",
+            "model": {"engine": "llm", "config": model, "options": options},
+        },
+        headers=auth,
+    )
+    doc = await resp.json()
+    assert doc.get("success"), doc
+    aid = doc["data"]["id"]
+    try:
+        return await _drive_tier(session, auth, backend, tier, attempts, aid, t_deploy)
+    except Exception:
+        # ANY failure after deploy must release the agent: a leaked engine
+        # holds the chip and the single-client TPU tunnel, and the next
+        # tier could never even initialize behind it
+        await _agent_teardown(session, auth, aid)
+        raise
+
+
+async def _drive_tier(
+    session, auth, backend, tier: dict, attempts: list, aid: str, t_deploy: float
+) -> dict | None:
+    model, quant = tier["model"], tier["quant"]
+    resp = await session.post(f"/agents/{aid}/start", headers=auth)
+    assert resp.status == 200, await resp.text()
+
+    # wait until the model is actually loaded (engine answers 503 with a
+    # loading marker until then; the journal queues those). Bounded per
+    # tier: a load that stalls (wedged tunnel, OOM, bad config) drops to
+    # the next tier with the last /metrics snapshot kept as telemetry.
+    load_deadline = time.monotonic() + tier["deadline_s"]
+    m: dict = {}
+    while True:
+        m = await _metrics(session, aid)
+        if m.get("model_loaded"):
+            break
+        if m.get("engine_error"):
+            attempts.append({"tier": dict(tier), "engine_error": m["engine_error"]})
+            log(f"tier {model}: engine failed: {m['engine_error']}")
+            await _agent_teardown(session, auth, aid)
+            return None
+        if time.monotonic() > load_deadline:
+            attempts.append(
+                {
+                    "tier": dict(tier),
+                    "error": f"model load timed out after {tier['deadline_s']:.0f}s",
+                    "last_metrics": m,
+                }
             )
+            log(f"tier {model}: load timed out; falling back")
+            await _agent_teardown(session, auth, aid)
+            return None
+        await asyncio.sleep(2.0)
+    load_s = time.monotonic() - t_deploy
+    log(f"model {model}{'+' + quant if quant else ''} loaded in {load_s:.0f}s")
+
+    # warmup: one full-length turn + one follow-up per session, so every
+    # prefill bucket the measured turns will hit is already compiled and
+    # the engine's TTFT histogram reflects steady-state serving
+    await asyncio.gather(
+        *(_chat(session, aid, f"w{i}", PROMPT, 8) for i in range(SESSIONS))
+    )
+    await asyncio.gather(
+        *(
+            _chat(session, aid, f"w{i}", "Turn 0: tell me more about it.", 8)
+            for i in range(SESSIONS)
         )
+    )
 
-        m0 = await _metrics(session, aid)
-        t0 = time.monotonic()
-        lat: list[float] = []
+    m0 = await _metrics(session, aid)
+    t0 = time.monotonic()
+    lat: list[float] = []
 
-        async def drive(i: int) -> None:
-            for t in range(TURNS):
-                msg = PROMPT if t == 0 else f"Turn {t}: tell me more about it."
-                s = time.monotonic()
-                r = await _chat(session, aid, f"s{i}", msg, MAX_TOKENS)
-                assert r["status"] == 200, r
-                lat.append(time.monotonic() - s)
+    async def drive(i: int) -> None:
+        for t in range(TURNS):
+            msg = PROMPT if t == 0 else f"Turn {t}: tell me more about it."
+            s = time.monotonic()
+            r = await _chat(session, aid, f"s{i}", msg, MAX_TOKENS)
+            assert r["status"] == 200, r
+            lat.append(time.monotonic() - s)
 
-        await asyncio.gather(*(drive(i) for i in range(SESSIONS)))
-        wall = time.monotonic() - t0
-        m1 = await _metrics(session, aid)
+    await asyncio.gather(*(drive(i) for i in range(SESSIONS)))
+    wall = time.monotonic() - t0
+    m1 = await _metrics(session, aid)
 
-        dflops = m1["flops_done"] - m0["flops_done"]
-        dtok = m1["tokens_generated"] - m0["tokens_generated"]
-        peak = m1["peak_tflops"] * 1e12
-        lat.sort()
+    dflops = m1["flops_done"] - m0["flops_done"]
+    dtok = m1["tokens_generated"] - m0["tokens_generated"]
+    peak = m1["peak_tflops"] * 1e12
+    lat.sort()
 
-        def _windowed_p50(samples: list, n_new: int, fallback) -> float | None:
-            # samples are append-ordered; the last n_new belong to the
-            # measured interval (warmup/compile entries precede them)
-            if not samples or n_new <= 0:
-                return fallback
-            win = sorted(samples[-min(n_new, len(samples)) :])
-            return win[len(win) // 2]
+    def _windowed_p50(samples: list, n_new: int, fallback) -> float | None:
+        # samples are append-ordered; the last n_new belong to the
+        # measured interval (warmup/compile entries precede them)
+        if not samples or n_new <= 0:
+            return fallback
+        win = sorted(samples[-min(n_new, len(samples)) :])
+        return win[len(win) // 2]
 
-        ttft_p50 = _windowed_p50(
-            m1.get("ttft_samples", []),
-            m1["prefills"] - m0["prefills"],
-            m1.get("ttft_ms_p50"),
-        )
-        itl_p50 = _windowed_p50(
-            m1.get("itl_samples", []),
-            m1["decode_steps"] - m0["decode_steps"],
-            m1.get("itl_ms_p50"),
-        )
-        llm = {
-            "model": MODEL + (f"+{QUANT}" if QUANT else ""),
-            "chip": m1.get("chip_kind"),
-            "n_chips": m1.get("n_chips"),
-            "ttft_ms_p50": ttft_p50,
-            "itl_ms_p50": itl_p50,
-            "tokens_per_s": round(dtok / wall, 1),
-            "mfu": round(dflops / wall / peak, 4),
-            "req_latency_ms_p50": round(1000 * statistics.median(lat), 1),
-            "req_latency_ms_p99": round(1000 * lat[int(0.99 * len(lat))], 1),
-            "batch_occupancy": m1.get("batch_occupancy"),
-            "requests": len(lat),
-            "engine_load_s": round(load_s, 1),
-            "hbm_bytes_per_chip": m1.get("hbm_bytes_per_chip_est"),
-        }
-        log(f"llm bench: {json.dumps(llm)}")
+    ttft_p50 = _windowed_p50(
+        m1.get("ttft_samples", []),
+        m1["prefills"] - m0["prefills"],
+        m1.get("ttft_ms_p50"),
+    )
+    itl_p50 = _windowed_p50(
+        m1.get("itl_samples", []),
+        m1["decode_steps"] - m0["decode_steps"],
+        m1.get("itl_ms_p50"),
+    )
+    llm = {
+        "model": model + (f"+{quant}" if quant else ""),
+        "chip": m1.get("chip_kind"),
+        "n_chips": m1.get("n_chips"),
+        "ttft_ms_p50": ttft_p50,
+        "itl_ms_p50": itl_p50,
+        "tokens_per_s": round(dtok / wall, 1),
+        "mfu": round(dflops / wall / peak, 4),
+        "req_latency_ms_p50": round(1000 * statistics.median(lat), 1),
+        "req_latency_ms_p99": round(1000 * lat[int(0.99 * len(lat))], 1),
+        "batch_occupancy": m1.get("batch_occupancy"),
+        "requests": len(lat),
+        "engine_load_s": round(load_s, 1),
+        "hbm_bytes_per_chip": m1.get("hbm_bytes_per_chip_est"),
+    }
+    log(f"llm bench: {json.dumps(llm)}")
 
-        # ---- crash-replay recovery (BASELINE metric #2) -----------------
-        # SIGKILL the engine mid-conversation, fire a request (journaled,
-        # 202), resume, and time kill -> that request's response served.
-        pid = None
-        try:
-            for rec in backend._recs.values():  # bench-only peek at the backend
-                if rec.agent_id == aid and rec.proc is not None:
-                    pid = rec.proc.pid
-        except Exception:
-            pass
-        recovery_ms = None
-        sent = False
-        if pid:
-            marker = ""
-            t_kill = time.monotonic()
-            os.kill(pid, signal.SIGKILL)
-            # journaled request fired immediately after the kill: 202 (agent
-            # already marked down) and 502 (dispatch hit the dead engine)
-            # both leave the entry pending for replay; 200 means the kill
-            # raced a still-alive engine — retry with a FRESH marker each
-            # attempt so a 200'd marker can't satisfy the history poll below
-            for attempt in range(50):
-                marker = f"did you survive {time.monotonic_ns()}-{attempt}?"
-                r = await _chat(session, aid, "recovery", marker, 8)
-                if r["status"] in (202, 502):
-                    sent = True
-                    break
-                await asyncio.sleep(0.1)
-            if sent:
-                # resume → replay worker re-dispatches the queued request
-                await session.post(f"/agents/{aid}/resume", headers=auth)
-                deadline = time.monotonic() + 1500
-                while time.monotonic() < deadline:
-                    async with session.get(f"/agent/{aid}/history") as resp:
-                        if resp.status == 200:
-                            h = await resp.json()
-                            if any(
-                                marker in t.get("content", "")
-                                for t in h.get("history", [])
-                                if t.get("role") == "user"
-                            ):
-                                recovery_ms = 1000 * (time.monotonic() - t_kill)
-                                break
-                    await asyncio.sleep(1.0)
-            llm["recovery_ms"] = round(recovery_ms, 0) if recovery_ms else None
-            llm["recovery_request_queued"] = sent
-            log(f"crash-replay recovery: {llm['recovery_ms']} ms")
+    # ---- crash-replay recovery (BASELINE metric #2) -----------------
+    # SIGKILL the engine mid-traffic, fire a request (journaled, 202),
+    # resume, and time kill -> that request's response served. Runs LAST:
+    # on this image a SIGKILL'd TPU client can wedge the tunnel, so the
+    # headline numbers above are already banked if it does.
+    pid = None
+    try:
+        for rec in backend._recs.values():  # bench-only peek at the backend
+            if rec.agent_id == aid and rec.proc is not None:
+                pid = rec.proc.pid
+    except Exception:
+        pass
+    recovery_ms = None
+    sent = False
+    if pid and os.environ.get("ATPU_BENCH_RECOVERY", "1") != "0":
+        marker = ""
+        t_kill = time.monotonic()
+        os.kill(pid, signal.SIGKILL)
+        # journaled request fired immediately after the kill: 202 (agent
+        # already marked down) and 502 (dispatch hit the dead engine)
+        # both leave the entry pending for replay; 200 means the kill
+        # raced a still-alive engine — retry with a FRESH marker each
+        # attempt so a 200'd marker can't satisfy the history poll below
+        for attempt in range(50):
+            marker = f"did you survive {time.monotonic_ns()}-{attempt}?"
+            r = await _chat(session, aid, "recovery", marker, 8)
+            if r["status"] in (202, 502):
+                sent = True
+                break
+            await asyncio.sleep(0.1)
+        if sent:
+            # resume → replay worker re-dispatches the queued request
+            await session.post(f"/agents/{aid}/resume", headers=auth)
+            deadline = time.monotonic() + RECOVERY_DEADLINE_S
+            while time.monotonic() < deadline:
+                async with session.get(f"/agent/{aid}/history") as resp:
+                    if resp.status == 200:
+                        h = await resp.json()
+                        if any(
+                            marker in t.get("content", "")
+                            for t in h.get("history", [])
+                            if t.get("role") == "user"
+                        ):
+                            recovery_ms = 1000 * (time.monotonic() - t_kill)
+                            break
+                await asyncio.sleep(1.0)
+        llm["recovery_ms"] = round(recovery_ms, 0) if recovery_ms else None
+        llm["recovery_request_queued"] = sent
+        log(f"crash-replay recovery: {llm['recovery_ms']} ms")
 
     return llm
 
@@ -269,7 +369,7 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": f"llm_ttft_ms_p50_{llm['model']}",
+                "metric": f"llm_ttft_ms_p50_{llm.get('model', 'none')}",
                 "value": north,
                 "unit": "ms",
                 "vs_baseline": round(200.0 / north, 3) if north else None,

@@ -139,6 +139,53 @@ def test_crash_replay_with_real_processes(tmp_path):
     run(body())
 
 
+def test_resume_immediately_after_kill_rehydrates(tmp_path):
+    """Race regression: for a beat after SIGKILL, proc.poll() still returns
+    None while the engine's port already refuses — a resume issued in that
+    window used to see EngineState.RUNNING, no-op, and return success for a
+    dead engine (the reconciler then marked the agent STOPPED forever).
+    resume must probe real liveness and rehydrate."""
+
+    async def body():
+        services, client = await start_stack(tmp_path)
+        try:
+            resp = await client.post(
+                "/agents", json={"name": "echo-race", "model": "echo"}, headers=AUTH
+            )
+            agent = (await resp.json())["data"]
+            resp = await client.post(f"/agents/{agent['id']}/start", headers=AUTH)
+            assert resp.status == 200, await resp.text()
+            resp = await client.post(
+                f"/agent/{agent['id']}/chat", data=json.dumps({"message": "alive"})
+            )
+            assert resp.status == 200
+
+            # SIGKILL and resume IMMEDIATELY — inside the poll() lying window
+            import os
+            import signal as _signal
+
+            engine_id = services.manager.get_agent(agent["id"]).engine_id
+            rec = services.backend._recs[engine_id]
+            os.killpg(rec.proc.pid, _signal.SIGKILL)
+            resp = await client.post(f"/agents/{agent['id']}/resume", headers=AUTH)
+            assert resp.status == 200, await resp.text()
+
+            # the resumed agent must actually serve (rehydrated engine)
+            deadline = asyncio.get_event_loop().time() + 30
+            while True:
+                resp = await client.post(
+                    f"/agent/{agent['id']}/chat", data=json.dumps({"message": "back?"})
+                )
+                if resp.status == 200:
+                    break
+                assert asyncio.get_event_loop().time() < deadline, await resp.text()
+                await asyncio.sleep(0.5)
+        finally:
+            await teardown(services, client)
+
+    run(body())
+
+
 def test_auto_restart_policy_respawns_engine(tmp_path):
     """RestartPolicy-always parity (agent.go:482-495): the backend watcher
     respawns a crashed engine without control-plane involvement."""

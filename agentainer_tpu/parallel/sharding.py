@@ -52,12 +52,19 @@ def param_specs(moe: bool) -> dict:
     }
 
 
-def param_shardings(mesh: Mesh, moe: bool = False) -> dict:
+def shardings_from_specs(mesh: Mesh, specs) -> dict:
+    """Map an arbitrary PartitionSpec tree onto ``mesh`` — THE one place a
+    spec becomes a NamedSharding (init-time out_shardings and serve-time
+    device_put must agree or weights silently reshard)."""
     return jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),
-        param_specs(moe),
+        specs,
         is_leaf=lambda x: isinstance(x, P),
     )
+
+
+def param_shardings(mesh: Mesh, moe: bool = False) -> dict:
+    return shardings_from_specs(mesh, param_specs(moe))
 
 
 def scale_spec(spec: P) -> P:
@@ -70,19 +77,27 @@ def scale_spec(spec: P) -> P:
     return P(*parts)
 
 
+def qtensor_sharding(mesh: Mesh, spec: P):
+    """Shardings for an int8 ``QTensor(q, scale)`` leaf: q gets the dense
+    weight's spec, scale gets it with the contraction axis unsharded."""
+    from ..ops.quant import QTensor
+
+    return QTensor(
+        q=NamedSharding(mesh, spec),
+        scale=NamedSharding(mesh, scale_spec(spec)),
+    )
+
+
 def param_shardings_for(params: dict, mesh: Mesh, moe: bool = False) -> dict:
     """Sharding tree matching an ACTUAL params pytree, including int8
-    ``QTensor(q, scale)`` leaves (ops/quant.py): q gets the dense weight's
-    spec, scale gets it with the contraction axis unsharded. This is what
-    lets quantized models keep serve-time TP (VERDICT round-1 item 2)."""
+    ``QTensor(q, scale)`` leaves (ops/quant.py) via qtensor_sharding. This
+    is what lets quantized models keep serve-time TP (VERDICT round-1
+    item 2)."""
     from ..ops.quant import QTensor
 
     def mk(spec, leaf):
         if isinstance(leaf, QTensor):
-            return QTensor(
-                q=NamedSharding(mesh, spec),
-                scale=NamedSharding(mesh, scale_spec(spec)),
-            )
+            return qtensor_sharding(mesh, spec)
         return NamedSharding(mesh, spec)
 
     return jax.tree.map(

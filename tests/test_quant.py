@@ -152,12 +152,31 @@ def test_synthetic_int8_engine_generates():
         engine.shutdown()
 
 
-def test_synthetic_refuses_meshed():
-    import pytest as _pytest
+def test_synthetic_meshed_matches_single_device():
+    """Meshed synthetic init (sharded generation, VERDICT r3 missing #3)
+    produces the same weights as the single-device path — threefry is
+    placement-deterministic — so greedy tokens agree across layouts."""
+    import asyncio
 
     from agentainer_tpu.engine.llm import LLMEngine
 
-    with _pytest.raises(ValueError, match="single-device"):
-        LLMEngine.create(
-            "tiny", options={"quant": "int8", "synthetic": True, "tp": 2, "max_batch": 2}
-        )
+    e1 = LLMEngine.create(
+        "tiny", options={"quant": "int8", "synthetic": True, "max_batch": 2, "max_seq": 128}
+    )
+    e2 = LLMEngine.create(
+        "tiny",
+        options={"quant": "int8", "synthetic": True, "tp": 2, "max_batch": 2, "max_seq": 128},
+    )
+    try:
+        assert e2.tp == 2
+
+        async def go(e):
+            r = await e.chat(session="s", message="the quick brown fox", max_tokens=6)
+            return r["tokens"]
+
+        t1 = asyncio.run(go(e1))
+        t2 = asyncio.run(go(e2))
+        assert t1 == t2, (t1, t2)
+    finally:
+        e1.shutdown()
+        e2.shutdown()

@@ -1,0 +1,115 @@
+"""Routed (token-dispatch) MoE — VERDICT r3 missing #5.
+
+The dense MoE fallback computes EVERY expert for every token and masks at
+combine (~E/k× wasted MLP FLOPs); the routed path dispatches each token to
+its top-k experts' fixed-capacity buffers and computes only that work.
+Invariants: routed == dense when nothing drops (dispatch relocates compute,
+not math); capacity clamps make droplessness reachable; the engine defaults
+to routed wherever experts shard over ep; the FLOP model charges k experts
+per token, not E.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agentainer_tpu.engine.llm import LLMEngine
+from agentainer_tpu.models.configs import ModelConfig, get_config
+from agentainer_tpu.models.llama import (
+    _moe_mlp,
+    _moe_mlp_routed,
+    init_params,
+    routed_capacity,
+)
+
+
+def _layer0(cfg):
+    params = init_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    return {k: v[0] for k, v in params["layers"].items() if k in ("router", "w_gate", "w_up", "w_down")}
+
+
+def test_routed_matches_dense_when_dropless():
+    cfg = get_config("tiny-moe")
+    lp = _layer0(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(5), (2, 16, cfg.dim), jnp.float32)
+    dense = _moe_mlp(x, lp, cfg)
+    # capacity_factor E/k ⇒ C = N: dropless regardless of routing skew
+    routed = _moe_mlp_routed(x, lp, cfg, capacity_factor=cfg.n_experts / cfg.experts_per_token)
+    np.testing.assert_allclose(np.asarray(routed), np.asarray(dense), atol=1e-5)
+
+
+def test_routed_drops_overflow_tokens_without_crashing():
+    cfg = get_config("tiny-moe")
+    lp = _layer0(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(5), (1, 32, cfg.dim), jnp.float32)
+    out = _moe_mlp_routed(x, lp, cfg, capacity_factor=0.05)  # C=1: heavy drops
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_routed_capacity_model():
+    # cf × balanced share, ceil'd…
+    assert routed_capacity(1024, 8, 2, 2.0) == 512
+    assert routed_capacity(1024, 8, 2, 1.0) == 256
+    # …clamped at N (a token takes at most one slot per expert)
+    assert routed_capacity(8, 8, 2, 16.0) == 8
+    assert routed_capacity(1, 8, 2, 1.0) == 1
+
+
+def test_flop_model_charges_k_not_E():
+    """Per-token MLP FLOPs follow experts_per_token: doubling the expert
+    count (k fixed) must not change flops_per_token, and the MoE model's
+    per-token cost equals the dense-FFN cost at k=1 scale."""
+    base = get_config("tiny-moe")
+    doubled = ModelConfig(
+        name="tiny-moe-2x",
+        vocab_size=base.vocab_size,
+        dim=base.dim,
+        n_layers=base.n_layers,
+        n_heads=base.n_heads,
+        n_kv_heads=base.n_kv_heads,
+        ffn_dim=base.ffn_dim,
+        n_experts=base.n_experts * 2,
+        experts_per_token=base.experts_per_token,
+    )
+    # router cost differs by E (D·E per token — negligible but exact), so
+    # compare with the router term removed
+    def mlp_flops(cfg):
+        return cfg.flops_per_token(0) - 2.0 * cfg.n_layers * cfg.dim * cfg.n_experts
+
+    assert mlp_flops(base) == mlp_flops(doubled)
+
+
+def test_single_chip_engine_routed_opt_in_matches_dense():
+    dense = LLMEngine.create("tiny-moe", options={"max_batch": 2, "max_seq": 128})
+    routed = LLMEngine.create(
+        "tiny-moe",
+        # dropless capacity so greedy tokens are comparable
+        options={"max_batch": 2, "max_seq": 128, "routed": True, "moe_cf": 2.0},
+    )
+    try:
+        assert dense.routed_moe is False
+        assert routed.routed_moe is True
+        a = asyncio.run(dense.generate("routed moe parity", max_tokens=6))
+        b = asyncio.run(routed.generate("routed moe parity", max_tokens=6))
+        assert a["tokens"] == b["tokens"], (a["tokens"], b["tokens"])
+    finally:
+        dense.shutdown()
+        routed.shutdown()
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs the virtual CPU mesh")
+def test_meshed_ep_engine_defaults_to_routed_and_matches_dense():
+    ref = LLMEngine.create("tiny-moe", options={"max_batch": 2, "max_seq": 128})
+    ep = LLMEngine.create("tiny-moe", options={"max_batch": 2, "max_seq": 128, "ep": 4})
+    try:
+        assert ep.routed_moe is True, "ep>1 must default to routed compute"
+        assert ep.metrics()["moe_routed"] is True
+        a = asyncio.run(ref.generate("routed ep parity", max_tokens=6))
+        b = asyncio.run(ep.generate("routed ep parity", max_tokens=6))
+        assert a["tokens"] == b["tokens"], (a["tokens"], b["tokens"])
+    finally:
+        ref.shutdown()
+        ep.shutdown()

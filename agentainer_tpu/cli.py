@@ -100,9 +100,25 @@ def cmd_deploy(args) -> None:
                     _call(args, "POST", f"/agents/{agent['id']}/start")
                     print(f"started {agent['id']}")
         return
+    model: object = args.model
+    if getattr(args, "model_dir", ""):
+        # deploy-from-directory (builder.go:98-218 analogue): validate +
+        # register the checkpoint dir as a dedup-named artifact with build
+        # progress, then deploy an llm agent serving it
+        doc = _call(
+            args,
+            "POST",
+            "/artifacts",
+            {"path": args.model_dir, "name": args.name or ""},
+        )
+        art = doc["data"]
+        for line in art.get("build_log", []):
+            print(f"  {line}")
+        print(f"built artifact {art['name']!r}")
+        model = {"engine": "llm", "artifact": art["name"]}
     body = {
         "name": args.name,
-        "model": args.model,
+        "model": model,
         "env": _parse_env(args.env),
         "resources": {"chips": args.chips, "hbm_bytes": args.hbm_bytes},
         "auto_restart": args.auto_restart,
@@ -209,6 +225,17 @@ def cmd_metrics(args) -> None:
         _print(_call(args, "GET", "/metrics")["data"])
 
 
+def cmd_models(args) -> None:
+    doc = _call(args, "GET", "/artifacts")
+    rows = doc["data"]
+    if not rows:
+        print("no artifacts registered (deploy --model-dir ./checkpoint to add one)")
+        return
+    for a in rows:
+        params = f"{a['n_params'] / 1e6:.1f}M" if a.get("n_params") else "?"
+        print(f"{a['name']:24s} {a['layout']:6s} {params:>10s}  {a['path']}")
+
+
 def cmd_slice(args) -> None:
     _print(_call(args, "GET", "/slice")["data"])
 
@@ -296,6 +323,13 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("deploy", help="deploy an agent (or -f deployment.yaml)")
     s.add_argument("--name")
     s.add_argument("--model", default="echo", help='engine[:config], e.g. "llm:llama3-8b"')
+    s.add_argument(
+        "--model-dir",
+        default="",
+        help="deploy from a local checkpoint directory (HF config.json + "
+        "safetensors, or an orbax save): validates, registers a dedup-named "
+        "artifact, and serves it with the llm engine",
+    )
     s.add_argument("--env", action="append", default=[], metavar="KEY=VALUE")
     s.add_argument("--chips", type=int, default=1)
     s.add_argument("--hbm-bytes", type=int, default=8 * 1024**3)
@@ -347,6 +381,9 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("agent_id", nargs="?", default="")
     s.add_argument("--history", action="store_true")
     s.set_defaults(fn=cmd_metrics)
+
+    s = sub.add_parser("models", help="registered model artifacts")
+    s.set_defaults(fn=cmd_models)
 
     s = sub.add_parser("slice", help="chip topology + placements")
     s.set_defaults(fn=cmd_slice)

@@ -40,6 +40,7 @@ class Services:
     logs: LogPlane
     metrics: MetricsPlane
     backups: BackupManager
+    artifacts: "ArtifactRegistry" = None  # type: ignore[assignment]
     data_dir: str = ""
     health: HealthMonitor = None  # type: ignore[assignment]
     quick_sync: QuickSync = None  # type: ignore[assignment]
@@ -98,6 +99,9 @@ def build_services(
         manager, store, interval_s=config.cadences.metrics_interval_s, logs=logs
     )
     backups = BackupManager(manager, store, ddir)
+    from .manager.artifacts import ArtifactRegistry
+
+    artifacts = ArtifactRegistry(store)
 
     services = Services(
         config=config,
@@ -109,6 +113,7 @@ def build_services(
         logs=logs,
         metrics=metrics,
         backups=backups,
+        artifacts=artifacts,
         data_dir=str(ddir),
     )
 
@@ -129,7 +134,11 @@ def build_services(
 
     services.health = HealthMonitor(manager, store, services.dispatch)
     services.replay = ReplayWorker(
-        journal, manager, services.dispatch, interval_s=config.cadences.replay_scan_s
+        journal,
+        manager,
+        services.dispatch,
+        interval_s=config.cadences.replay_scan_s,
+        backend=backend,
     )
     return services
 

@@ -57,12 +57,14 @@ def load_params(cfg: ModelConfig, path: str | Path, dtype=jnp.bfloat16) -> dict:
 SNAP_VERSION = 1
 
 
-def serialize_kv_slot(cache: KVCache, slot: int, position: int, meta: dict | None = None) -> bytes:
-    """Pack one slot's live KV prefix ([L, position, KV, hd] per k/v) into a
-    self-describing npz blob. Only the written prefix ships — a 100-token
-    conversation snapshot is ~100/S of the slot arena."""
-    k = np.asarray(cache.k[:, slot, :position].astype(jnp.float16))
-    v = np.asarray(cache.v[:, slot, :position].astype(jnp.float16))
+def pack_kv_snapshot(k16, v16, position: int, meta: dict | None = None) -> bytes:
+    """Host half of a KV snapshot: block on the staged fp16 device buffers
+    (bucket-padded [L, bucket, KV, hd] — the engine's worker dispatched the
+    slice), trim to the live prefix, and pack a self-describing npz blob.
+    Only the written prefix ships — a 100-token conversation snapshot is
+    ~100/S of the slot arena."""
+    k = np.asarray(k16)[:, :position]
+    v = np.asarray(v16)[:, :position]
     buf = io.BytesIO()
     header = json.dumps({"version": SNAP_VERSION, "position": position, **(meta or {})})
     np.savez_compressed(buf, k=k, v=v, header=np.frombuffer(header.encode(), dtype=np.uint8))

@@ -139,6 +139,19 @@ class AgentManager:
         if ref.engine not in known_engines():
             raise InvalidInput(f"unknown engine {ref.engine!r}; known: {sorted(known_engines())}")
         if is_tpu_engine(ref.engine):
+            if not ref.config and ref.checkpoint:
+                # HF checkpoints carry their own config.json; the engine
+                # derives the model config from the checkpoint itself
+                # (LLMEngine.create → config_from_hf), so "checkpoint only"
+                # is a valid deploy — the artifact flow depends on it
+                from ..engine.hf_convert import is_hf_checkpoint
+
+                if is_hf_checkpoint(ref.checkpoint):
+                    return
+                raise InvalidInput(
+                    f"checkpoint {ref.checkpoint!r} has no model config: name "
+                    f"one explicitly (model.config) or point at an HF layout"
+                )
             from ..models.configs import get_config
 
             try:

@@ -1,0 +1,228 @@
+"""Model-artifact builder — the image-builder analogue (VERDICT r4 item 9).
+
+The reference turns a user-supplied source directory into a deployable,
+dedup-named Docker image with streamed build progress
+(pkg/docker/builder.go:98-218; CLI spinner cmd/agentainer/main.go:404-443).
+Here the user-supplied artifact is a model checkpoint directory — HF layout
+(config.json + *.safetensors) or our own orbax save (engine/checkpoint.py) —
+and "building" means:
+
+1. **detect** the layout (the ``IsDockerfile`` heuristic analogue,
+   builder.go:39-84);
+2. **validate** it against the derived model config — every expected tensor
+   present with the right shape, read from safetensors METADATA so an 8B
+   checkpoint validates in milliseconds without loading a byte of weights;
+3. **register** it under a dedup'd name (``name``, ``name-2``, ... — the
+   PreventDuplicateImage analogue, builder.go:196-218) in the store, so
+   ``deploy`` can reference the artifact by name and ``agentainer models``
+   can list what is available.
+
+Progress is streamed through a callback (the CLI prints the lines; the API
+returns them in the response body) — parity with the reference's build
+progress channel (builder.go:150-187).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Callable
+
+from ..core.errors import AgentainerError
+from ..store.base import Store
+
+ARTIFACT_KEY = "artifact:{name}"
+ARTIFACTS_LIST = "artifacts:list"
+
+Progress = Callable[[str], None]
+
+
+class ArtifactError(AgentainerError):
+    http_status = 400
+
+
+def detect_layout(path: str | Path) -> str | None:
+    """'hf' | 'orbax' | None — the IsDockerfile-style heuristic."""
+    p = Path(path).expanduser()
+    if not p.is_dir():
+        return None
+    if (p / "config.json").exists() and any(p.glob("*.safetensors")):
+        return "hf"
+    if (p / "params").is_dir():  # our own save_params layout
+        return "orbax"
+    return None
+
+
+def _expected_tensors(cfg) -> dict[str, tuple]:
+    """HF tensor name → expected shape, derived from the model config
+    (mirror of engine/hf_convert.py's mapping, torch [out, in] layout)."""
+    d, hd = cfg.dim, cfg.head_dim
+    exp: dict[str, tuple] = {
+        "model.embed_tokens.weight": (cfg.vocab_size, d),
+        "model.norm.weight": (d,),
+    }
+    for i in range(cfg.n_layers):
+        L = f"model.layers.{i}."
+        exp[L + "input_layernorm.weight"] = (d,)
+        exp[L + "post_attention_layernorm.weight"] = (d,)
+        exp[L + "self_attn.q_proj.weight"] = (cfg.n_heads * hd, d)
+        exp[L + "self_attn.k_proj.weight"] = (cfg.n_kv_heads * hd, d)
+        exp[L + "self_attn.v_proj.weight"] = (cfg.n_kv_heads * hd, d)
+        exp[L + "self_attn.o_proj.weight"] = (d, cfg.n_heads * hd)
+        if cfg.is_moe:
+            exp[L + "block_sparse_moe.gate.weight"] = (cfg.n_experts, d)
+            for e in range(cfg.n_experts):
+                E = L + f"block_sparse_moe.experts.{e}."
+                exp[E + "w1.weight"] = (cfg.ffn_dim, d)
+                exp[E + "w2.weight"] = (d, cfg.ffn_dim)
+                exp[E + "w3.weight"] = (cfg.ffn_dim, d)
+        else:
+            exp[L + "mlp.gate_proj.weight"] = (cfg.ffn_dim, d)
+            exp[L + "mlp.up_proj.weight"] = (cfg.ffn_dim, d)
+            exp[L + "mlp.down_proj.weight"] = (d, cfg.ffn_dim)
+    return exp
+
+
+def _validate_hf(path: Path, progress: Progress) -> dict:
+    """Metadata-only validation: shapes from safetensors headers, no weight
+    bytes loaded. Returns {config_name_hint, n_params, n_tensors, files}."""
+    from ..engine.hf_convert import _open_shards, config_from_hf
+
+    try:
+        cfg = config_from_hf(path)
+    except (OSError, KeyError, ValueError) as e:
+        raise ArtifactError(f"unreadable model config: {e}") from e
+    progress(
+        f"config: dim={cfg.dim} layers={cfg.n_layers} heads={cfg.n_heads}/"
+        f"{cfg.n_kv_heads} vocab={cfg.vocab_size}"
+        + (f" experts={cfg.n_experts}x{cfg.experts_per_token}" if cfg.is_moe else "")
+    )
+    shards = _open_shards(path)
+    progress(f"{len(shards)} tensors across {len(set(shards.values()))} shard file(s)")
+    from safetensors import safe_open
+
+    shapes: dict[str, tuple] = {}
+    handles: dict[Path, object] = {}
+    try:
+        for name, shard in shards.items():
+            h = handles.get(shard)
+            if h is None:
+                h = handles[shard] = safe_open(shard, framework="np")
+            shapes[name] = tuple(h.get_slice(name).get_shape())
+    finally:
+        for h in handles.values():
+            try:
+                h.__exit__(None, None, None)
+            except Exception:
+                pass
+    exp = _expected_tensors(cfg)
+    missing = [n for n in exp if n not in shapes]
+    # tied embeddings: lm_head may legitimately be absent
+    if missing:
+        raise ArtifactError(f"missing tensors (first 5): {missing[:5]}")
+    bad = [
+        (n, shapes[n], want)
+        for n, want in exp.items()
+        if shapes[n] != want
+    ]
+    if bad:
+        n, got, want = bad[0]
+        raise ArtifactError(f"shape mismatch: {n} is {got}, expected {want}")
+    n_params = sum(int(__import__("math").prod(s)) for s in shapes.values())
+    progress(f"validated {len(shapes)} tensors, {n_params / 1e6:.1f}M params")
+    return {
+        "n_params": n_params,
+        "n_tensors": len(shapes),
+        "files": sorted({str(s.name) for s in set(shards.values())}),
+        "config": {
+            "dim": cfg.dim,
+            "n_layers": cfg.n_layers,
+            "n_heads": cfg.n_heads,
+            "n_kv_heads": cfg.n_kv_heads,
+            "vocab_size": cfg.vocab_size,
+            "is_moe": cfg.is_moe,
+        },
+    }
+
+
+class ArtifactRegistry:
+    def __init__(self, store: Store):
+        self.store = store
+
+    def _names(self) -> set[str]:
+        return set(self.store.smembers(ARTIFACTS_LIST))
+
+    def dedup_name(self, base: str) -> str:
+        """``base``, else ``base-2``, ``base-3``, ... (builder.go:196-218)."""
+        names = self._names()
+        if base not in names:
+            return base
+        n = 2
+        while f"{base}-{n}" in names:
+            n += 1
+        return f"{base}-{n}"
+
+    def build(
+        self, path: str | Path, name: str = "", progress: Progress | None = None
+    ) -> dict:
+        """Validate + register a model directory; returns the artifact doc."""
+        lines: list[str] = []
+
+        def note(msg: str) -> None:
+            lines.append(msg)
+            if progress is not None:
+                progress(msg)
+
+        p = Path(path).expanduser().resolve()
+        layout = detect_layout(p)
+        if layout is None:
+            raise ArtifactError(
+                f"{p} is not a model directory (expected HF config.json + "
+                f"*.safetensors, or an orbax params/ dir)"
+            )
+        note(f"detected {layout} checkpoint layout at {p}")
+        if layout == "hf":
+            info = _validate_hf(p, note)
+        else:
+            # orbax saves carry no model config of their own — deploys of
+            # this artifact must name model.config explicitly (the engine
+            # would otherwise have no architecture to restore into)
+            note(
+                "orbax layout: deferring validation to engine load; "
+                "deploys must set model.config explicitly"
+            )
+            info = {"n_params": None, "n_tensors": None, "files": ["params/"]}
+        final = self.dedup_name(name or p.name or "model")
+        if final != (name or p.name):
+            note(f"name in use; registering as {final!r}")
+        doc = {
+            "name": final,
+            "path": str(p),
+            "layout": layout,
+            "created_at": time.time(),
+            "build_log": lines,
+            **info,
+        }
+        self.store.set_json(ARTIFACT_KEY.format(name=final), doc)
+        self.store.sadd(ARTIFACTS_LIST, final)
+        note(f"registered artifact {final!r}")
+        return doc
+
+    def get(self, name: str) -> dict | None:
+        return self.store.get_json(ARTIFACT_KEY.format(name=name))
+
+    def list(self) -> list[dict]:
+        out = []
+        for name in sorted(self._names()):
+            doc = self.get(name)
+            if doc:
+                out.append(doc)
+        return out
+
+    def remove(self, name: str) -> bool:
+        if self.get(name) is None:
+            return False
+        self.store.delete(ARTIFACT_KEY.format(name=name))
+        self.store.srem(ARTIFACTS_LIST, name)
+        return True

@@ -111,6 +111,12 @@ class MetricsPlane:
             engine_stats = self.manager.backend.stats(agent.engine_id)
             if engine_stats:
                 sample["engine"] = engine_stats
+            # host-process half of the picture (CPU%/RSS via /proc): on a
+            # TPU-VM the host side is what throttles serving
+            if hasattr(self.manager.backend, "host_stats"):
+                host = self.manager.backend.host_stats(agent.engine_id)
+                if host:
+                    sample["host"] = host
         placement = self.manager.scheduler.placement(agent_id)
         if placement:
             sample["placement"] = placement.to_dict()

@@ -114,6 +114,12 @@ def _moe_mlp(x: jnp.ndarray, lp: dict, cfg: ModelConfig) -> jnp.ndarray:
     return jnp.einsum("bted,bte->btd", expert_out, combine)
 
 
+# token counts at or below this run routed MoE with cap = n (dropless):
+# covers every decode call (n = max_batch lanes) without inflating prefill
+# dispatch buffers
+_DROPLESS_MAX_N = 64
+
+
 def routed_capacity(n_tokens: int, n_experts: int, k: int, capacity_factor: float) -> int:
     """Static per-expert dispatch-buffer size: ``capacity_factor`` × the
     perfectly-balanced share (n·k/E), clamped to n — top-k indices are
@@ -153,7 +159,17 @@ def _moe_mlp_routed(
     w_gate = lp["w_gate"]
     e_loc = w_gate.shape[0]
     n, k = b * t, cfg.experts_per_token
-    cap = routed_capacity(n, cfg.n_experts, k, capacity_factor)
+    # Decode-sized calls (t==1, n = max_batch) go DROPLESS: the engine's
+    # pipelined decode feeds every lane — including parked/idle ones —
+    # through this path, and cumsum slot assignment would let a parked
+    # lane's garbage token steal a real token's expert capacity (ADVICE
+    # r4). cap = n makes stealing impossible and costs almost nothing at
+    # decode batch sizes; prefill (n = bucket, all real tokens from ONE
+    # sequence) keeps the cf-bounded buffers.
+    if n <= _DROPLESS_MAX_N:
+        cap = n
+    else:
+        cap = routed_capacity(n, cfg.n_experts, k, capacity_factor)
     xf = x.reshape(n, d)
     logits = xf @ lp["router"]  # [N, E] — full expert set
     weights, chosen = lax.top_k(logits, k)

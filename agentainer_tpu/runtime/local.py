@@ -48,6 +48,16 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+# serve-level knobs that do not change the loaded model: they must not
+# fragment the weight-sharing key (two personas over one checkpoint share)
+_PERSONA_OPTS = (
+    "system_prompt",
+    "flatten_history",
+    "history_turns",
+    "kv_snapshot_interval_s",
+)
+
+
 @dataclass
 class _EngineRec:
     engine_id: str
@@ -62,6 +72,29 @@ class _EngineRec:
     paused: bool = False
     desired_running: bool = False
     restarts: int = 0
+    log_file: object = None
+    # multi-tenant model host (llm_serve engines): this rec is a TENANT of
+    # the shared host process keyed by share_key; proc stays None
+    share_key: tuple | None = None
+    attached: bool = False
+
+
+@dataclass
+class _HostRec:
+    """One multi-tenant engine process: one model load, N agents attached.
+
+    This is what makes BASELINE config #4 physically true (VERDICT r4 item
+    5): separate per-agent processes each loaded their own weight copy and
+    could not even co-open a single-client TPU chip; a host process holds
+    ONE params pytree and serves every same-(model, chips) agent from it.
+    """
+
+    key: tuple
+    port: int
+    admin_token: str
+    env: dict[str, str]
+    log_path: Path
+    proc: subprocess.Popen | None = None
     log_file: object = None
 
 
@@ -83,6 +116,9 @@ class LocalBackend(Backend):
         (self._dir / "engines").mkdir(parents=True, exist_ok=True)
         self._lock = threading.RLock()
         self._recs: dict[str, _EngineRec] = {}
+        self._hosts: dict[tuple, _HostRec] = {}
+        # host CPU accounting deltas: engine_id -> (t, jiffies, pid)
+        self._cpu_last: dict[str, tuple[float, int, int]] = {}
         self._listeners: list[Callable[[str, EngineState], None]] = []
         self._watcher = threading.Thread(target=self._watch_loop, daemon=True)
         self._closed = False
@@ -157,6 +193,23 @@ class LocalBackend(Backend):
             auto_restart=agent.auto_restart,
             log_path=self._dir / "engines" / f"{engine_id}.log",
         )
+        from ..engine import engine_registry
+
+        if engine_registry().get(agent.model.engine) == "agentainer_tpu.engine.llm_serve":
+            # JAX engines become TENANTS of a shared model-host process:
+            # same (model, weights, engine knobs, chips) → same host, one
+            # weight copy in HBM. Persona knobs are serve-level and ride
+            # the attach call, so they don't fragment the share key.
+            opts = dict(agent.model.options or {})
+            for k in _PERSONA_OPTS:
+                opts.pop(k, None)
+            rec.share_key = (
+                agent.model.config,
+                agent.model.checkpoint,
+                json.dumps(opts, sort_keys=True),
+                chips,
+            )
+            rec.log_path = self._dir / "engines" / f"host-{self._host_slug(rec.share_key)}.log"
         with self._lock:
             self._recs[engine_id] = rec
         return engine_id
@@ -164,7 +217,9 @@ class LocalBackend(Backend):
     def start_engine(self, engine_id: str) -> None:
         with self._lock:
             rec = self._require(engine_id)
-            if rec.proc is not None and rec.proc.poll() is None:
+            if rec.share_key is not None:
+                rec.desired_running = True
+            elif rec.proc is not None and rec.proc.poll() is None:
                 rec.desired_running = True
                 if self._probe(rec.port):
                     return  # genuinely alive and answering
@@ -176,10 +231,193 @@ class LocalBackend(Backend):
                     time.sleep(0.05)
                 if rec.proc.poll() is None:
                     return  # alive but unresponsive: not ours to double-spawn
-            self._spawn(rec)
-            rec.desired_running = True
-        self._wait_ready(rec)
+                self._spawn(rec)
+            else:
+                self._spawn(rec)
+                rec.desired_running = True
+        if rec.share_key is not None:
+            self._ensure_host_and_attach(rec)
+        else:
+            self._wait_ready(rec)
         self._emit(engine_id, EngineState.RUNNING)
+
+    # -- multi-tenant model hosts -----------------------------------------
+    @staticmethod
+    def _host_slug(key: tuple) -> str:
+        import hashlib
+
+        return hashlib.sha1(repr(key).encode()).hexdigest()[:12]
+
+    def _ensure_host_and_attach(self, rec: _EngineRec) -> None:
+        """Make the share-key's host process live, then attach this agent as
+        a tenant (its own port + identity over the shared engine)."""
+        with self._lock:
+            host = self._hosts.get(rec.share_key)
+            if host is None or host.proc is None or host.proc.poll() is not None:
+                host = self._spawn_host(rec)
+        self._wait_host(host)
+        port = self._attach_tenant(host, rec)
+        with self._lock:
+            rec.port = port
+            rec.attached = True
+            rec.paused = False
+
+    def _spawn_host(self, rec: _EngineRec) -> _HostRec:
+        """Build + spawn the shared engine process from a tenant's env (the
+        model-level settings are identical across the share key by
+        construction; identity goes per-tenant at attach time)."""
+        host = self._hosts.get(rec.share_key)
+        if host is None:
+            env = dict(rec.env)
+            for k in (
+                "AGENTAINER_AGENT_ID",
+                "AGENTAINER_AGENT_NAME",
+                "AGENTAINER_INTERNAL_TOKEN",
+                "AGENTAINER_SYSTEM_PROMPT",
+            ):
+                env.pop(k, None)
+            slug = self._host_slug(rec.share_key)
+            env.update(
+                {
+                    "AGENTAINER_AGENT_ID": f"_host-{slug}",
+                    "AGENTAINER_AGENT_NAME": f"model-host-{slug}",
+                    "AGENTAINER_MULTI_TENANT": "1",
+                    "AGENTAINER_HOST_TOKEN": uuid.uuid4().hex + uuid.uuid4().hex,
+                    "AGENTAINER_PROFILE_DIR": str(self._dir / "profiles" / f"host-{slug}"),
+                }
+            )
+            host = _HostRec(
+                key=rec.share_key,
+                port=0,
+                admin_token=env["AGENTAINER_HOST_TOKEN"],
+                env=env,
+                log_path=self._dir / "engines" / f"host-{slug}.log",
+            )
+            self._hosts[rec.share_key] = host
+        # fresh port on EVERY (re)spawn: a dead host's old port may have
+        # been claimed by anyone in the meantime
+        host.port = _free_port()
+        host.env["AGENTAINER_PORT"] = str(host.port)
+        if host.log_file is not None:
+            try:
+                host.log_file.close()
+            except OSError:
+                pass
+        host.log_file = open(host.log_path, "ab")
+        host.env["AGENTAINER_CONTROL_URL"] = self.control_url
+        host.env["AGENTAINER_STORE_SOCK"] = self.store_sock
+        host.proc = subprocess.Popen(
+            [self.python, "-m", "agentainer_tpu.runtime.engine_main"],
+            env=host.env,
+            stdout=host.log_file,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        return host
+
+    def _wait_host(self, host: _HostRec) -> None:
+        deadline = time.time() + self.ready_timeout_s
+        while time.time() < deadline:
+            if host.proc is None or host.proc.poll() is not None:
+                raise RuntimeError(
+                    f"model host for {host.key[0]!r} exited during startup; "
+                    f"log tail: {self._tail_path(host.log_path, 20)}"
+                )
+            if self._probe(host.port, timeout=1.0):
+                return
+            time.sleep(0.05)
+        raise RuntimeError(f"model host not ready after {self.ready_timeout_s}s")
+
+    def _host_request(
+        self, host: _HostRec, method: str, path: str, body: dict | None = None
+    ) -> tuple[int, dict]:
+        import http.client
+        import json as _json
+
+        conn = http.client.HTTPConnection("127.0.0.1", host.port, timeout=30.0)
+        payload = _json.dumps(body or {}).encode()
+        conn.request(
+            method,
+            path,
+            body=payload,
+            headers={
+                "Authorization": f"Bearer {host.admin_token}",
+                "Content-Type": "application/json",
+            },
+        )
+        resp = conn.getresponse()
+        raw = resp.read()
+        conn.close()
+        try:
+            doc = _json.loads(raw) if raw else {}
+        except _json.JSONDecodeError:
+            doc = {"error": raw[:200].decode("utf-8", "replace")}
+        return resp.status, doc
+
+    def _attach_tenant(self, host: _HostRec, rec: _EngineRec) -> int:
+        status, doc = self._host_request(
+            host,
+            "POST",
+            "/-/tenants",
+            {
+                "agent_id": rec.agent_id,
+                "name": rec.env.get("AGENTAINER_AGENT_NAME", rec.agent_id),
+                "flavor": rec.env.get("AGENTAINER_ENGINE", "llm"),
+                "options": json.loads(rec.env.get("AGENTAINER_MODEL_OPTIONS", "{}") or "{}"),
+                "system_prompt": rec.env.get("AGENTAINER_SYSTEM_PROMPT", ""),
+                "token": rec.env.get("AGENTAINER_INTERNAL_TOKEN", ""),
+            },
+        )
+        if status != 200:
+            raise RuntimeError(f"tenant attach failed ({status}): {doc}")
+        return int(doc["port"])
+
+    def _detach_tenant_quiet(self, rec: _EngineRec) -> None:
+        host = self._hosts.get(rec.share_key)
+        if host is None or host.proc is None or host.proc.poll() is not None:
+            rec.attached = False
+            return
+        try:
+            self._host_request(host, "DELETE", f"/-/tenants/{rec.agent_id}")
+        except Exception:
+            # "quiet" means quiet: a host dying mid-DELETE raises
+            # http.client exceptions that are NOT OSError subclasses
+            pass
+        rec.attached = False
+
+    def _maybe_stop_host(self, key: tuple, timeout_s: float = 10.0) -> None:
+        """Kill the host process once no tenant needs it (frees the chips)."""
+        with self._lock:
+            live = any(
+                r.share_key == key and (r.desired_running or r.attached)
+                for r in self._recs.values()
+            )
+            host = self._hosts.get(key)
+        if live or host is None or host.proc is None or host.proc.poll() is not None:
+            return
+        try:
+            host.proc.terminate()
+            host.proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            host.proc.kill()
+            host.proc.wait(timeout=5)
+        except ProcessLookupError:
+            pass
+        if host.log_file is not None:
+            try:
+                host.log_file.close()
+            except OSError:
+                pass
+
+    def _tail_path(self, path: Path, tail: int) -> list[str]:
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - 256 * 1024))
+                return f.read().decode("utf-8", "replace").splitlines()[-tail:]
+        except OSError:
+            return []
 
     def _spawn(self, rec: _EngineRec) -> None:
         if rec.log_file is not None:  # respawn: don't leak the old handle
@@ -220,6 +458,13 @@ class LocalBackend(Backend):
             rec = self._require(engine_id)
             rec.desired_running = False
             proc = rec.proc
+        if rec.share_key is not None:
+            # tenant: detach from the shared host; the host itself dies only
+            # when its LAST tenant is gone (the weights outlive one agent)
+            self._detach_tenant_quiet(rec)
+            self._maybe_stop_host(rec.share_key, timeout_s)
+            self._emit(engine_id, EngineState.EXITED)
+            return
         if proc is None or proc.poll() is not None:
             return
         if rec.paused:
@@ -241,25 +486,48 @@ class LocalBackend(Backend):
     def pause_engine(self, engine_id: str) -> None:
         with self._lock:
             rec = self._require(engine_id)
-            if rec.proc is None or rec.proc.poll() is not None:
-                raise RuntimeError(f"engine {engine_id} not running")
-            os.killpg(rec.proc.pid, signal.SIGSTOP)
-            rec.paused = True
+            if rec.share_key is not None:
+                # tenant pause is a routing-level freeze: SIGSTOP would
+                # stop the shared process and every co-tenant with it. The
+                # control plane stops proxying (status=paused) and probe()
+                # reports down; the engine keeps serving its co-tenants.
+                if not rec.attached or not self._host_alive(rec.share_key):
+                    raise RuntimeError(f"engine {engine_id} not running")
+                rec.paused = True
+            else:
+                if rec.proc is None or rec.proc.poll() is not None:
+                    raise RuntimeError(f"engine {engine_id} not running")
+                os.killpg(rec.proc.pid, signal.SIGSTOP)
+                rec.paused = True
         self._emit(engine_id, EngineState.PAUSED)
 
     def resume_engine(self, engine_id: str) -> None:
         with self._lock:
             rec = self._require(engine_id)
-            if rec.proc is None or rec.proc.poll() is not None:
-                raise RuntimeError(f"engine {engine_id} not running")
-            os.killpg(rec.proc.pid, signal.SIGCONT)
-            rec.paused = False
+            if rec.share_key is not None:
+                if not rec.attached or not self._host_alive(rec.share_key):
+                    raise RuntimeError(f"engine {engine_id} not running")
+                rec.paused = False
+            else:
+                if rec.proc is None or rec.proc.poll() is not None:
+                    raise RuntimeError(f"engine {engine_id} not running")
+                os.killpg(rec.proc.pid, signal.SIGCONT)
+                rec.paused = False
         self._emit(engine_id, EngineState.RUNNING)
+
+    def _host_alive(self, key: tuple) -> bool:
+        host = self._hosts.get(key)
+        return host is not None and host.proc is not None and host.proc.poll() is None
 
     def remove_engine(self, engine_id: str) -> None:
         with self._lock:
             rec = self._recs.pop(engine_id, None)
         if rec is None:
+            return
+        if rec.share_key is not None:
+            self._detach_tenant_quiet(rec)
+            rec.desired_running = False
+            self._maybe_stop_host(rec.share_key, timeout_s=2.0)
             return
         if rec.proc is not None and rec.proc.poll() is None:
             try:
@@ -287,6 +555,14 @@ class LocalBackend(Backend):
             )
 
     def _state(self, rec: _EngineRec) -> EngineState:
+        if rec.share_key is not None:
+            if not rec.attached and not rec.desired_running:
+                return EngineState.CREATED if rec.restarts == 0 else EngineState.EXITED
+            if not self._host_alive(rec.share_key):
+                return EngineState.EXITED if rec.attached or rec.restarts else EngineState.CREATED
+            if not rec.attached:
+                return EngineState.CREATED
+            return EngineState.PAUSED if rec.paused else EngineState.RUNNING
         if rec.proc is None:
             return EngineState.CREATED
         if rec.proc.poll() is not None:
@@ -328,7 +604,7 @@ class LocalBackend(Backend):
         ContainerStats analogue, collector.go:228)."""
         with self._lock:
             rec = self._recs.get(engine_id)
-            if rec is None or rec.proc is None or rec.proc.poll() is not None or rec.paused:
+            if rec is None or self._state(rec) != EngineState.RUNNING:
                 return None
             port = rec.port
         import http.client
@@ -344,13 +620,62 @@ class LocalBackend(Backend):
         except (OSError, ValueError):
             return None
 
+    def host_stats(self, engine_id: str) -> dict | None:
+        """Host-side process stats for the engine: CPU% (delta over the
+        sampling interval) and RSS, read straight from /proc — the
+        ContainerStats CPU/mem half the TPU metrics plane was missing
+        (reference pkg/metrics/collector.go:249-298; VERDICT r4 item 8).
+        On a TPU-VM the HOST side (tokenization, store I/O, aiohttp) is
+        what throttles serving, so it needs to be visible per agent."""
+        with self._lock:
+            rec = self._recs.get(engine_id)
+            if rec is None:
+                return None
+            proc = rec.proc
+            if rec.share_key is not None:
+                host = self._hosts.get(rec.share_key)
+                proc = host.proc if host else None
+            if proc is None or proc.poll() is not None:
+                return None
+            pid = proc.pid
+        try:
+            with open(f"/proc/{pid}/stat", "rb") as f:
+                fields = f.read().rsplit(b") ", 1)[-1].split()
+            # fields[11]/[12] = utime/stime (fields 14/15 1-indexed, minus
+            # the 3 before the stripped comm)
+            jiffies = int(fields[11]) + int(fields[12])
+            with open(f"/proc/{pid}/statm", "rb") as f:
+                rss_pages = int(f.read().split()[1])
+        except (OSError, IndexError, ValueError):
+            return None
+        now = time.monotonic()
+        hz = os.sysconf("SC_CLK_TCK") or 100
+        page = os.sysconf("SC_PAGE_SIZE") or 4096
+        cpu_pct = None
+        prev = self._cpu_last.get(engine_id)
+        if prev is not None and prev[2] == pid:
+            dt = now - prev[0]
+            if dt > 0:
+                cpu_pct = round(100.0 * (jiffies - prev[1]) / hz / dt, 1)
+        self._cpu_last[engine_id] = (now, jiffies, pid)
+        return {
+            "pid": pid,
+            "host_cpu_pct": cpu_pct,
+            "host_rss_bytes": rss_pages * page,
+        }
+
     def probe_engine(self, engine_id: str) -> bool:
         """Real liveness: the engine answers /health. Process state alone
         lies for a beat after SIGKILL (poll() still None while the port
         already refuses) — resume() uses this to decide rehydration."""
         with self._lock:
             rec = self._recs.get(engine_id)
-            if rec is None or rec.proc is None or rec.paused:
+            if rec is None or rec.paused:
+                return False
+            if rec.share_key is not None:
+                if not rec.attached or not self._host_alive(rec.share_key):
+                    return False
+            elif rec.proc is None:
                 return False
             port = rec.port
         return self._probe(port)
@@ -404,10 +729,16 @@ class LocalBackend(Backend):
                     and not self._closed
                 ):
                     try:
-                        with self._lock:
-                            self._spawn(rec)
+                        if rec.share_key is not None:
+                            # host died: respawn it and re-attach this tenant
+                            rec.attached = False
+                            self._ensure_host_and_attach(rec)
                             rec.restarts += 1
-                        self._wait_ready(rec)
+                        else:
+                            with self._lock:
+                                self._spawn(rec)
+                                rec.restarts += 1
+                            self._wait_ready(rec)
                         self._emit(rec.engine_id, EngineState.RUNNING)
                         last[rec.engine_id] = EngineState.RUNNING
                     except Exception:
@@ -423,6 +754,23 @@ class LocalBackend(Backend):
             except Exception:
                 pass
             self.remove_engine(engine_id)
+        # belt-and-braces: no host process may outlive the backend (it holds
+        # the chips and the single-client TPU tunnel)
+        with self._lock:
+            hosts = list(self._hosts.values())
+            self._hosts.clear()
+        for host in hosts:
+            if host.proc is not None and host.proc.poll() is None:
+                try:
+                    os.killpg(host.proc.pid, signal.SIGKILL)
+                    host.proc.wait(timeout=5)
+                except (ProcessLookupError, subprocess.TimeoutExpired):
+                    pass
+            if host.log_file is not None:
+                try:
+                    host.log_file.close()
+                except OSError:
+                    pass
 
     def _require(self, engine_id: str) -> _EngineRec:
         rec = self._recs.get(engine_id)
@@ -430,11 +778,38 @@ class LocalBackend(Backend):
             raise KeyError(f"no such engine: {engine_id}")
         return rec
 
+    def engine_pid(self, agent_id: str) -> int | None:
+        """OS pid of the live engine process serving ``agent_id`` (None when
+        stopped). Public API: crash-injection tooling (bench_llm, chaos
+        tests) needs the pid to simulate a container death with SIGKILL.
+        For a tenant of a shared model host, this is the HOST's pid — the
+        process whose death takes the agent down."""
+        with self._lock:
+            for rec in self._recs.values():
+                if rec.agent_id != agent_id:
+                    continue
+                if rec.share_key is not None:
+                    if not rec.attached:
+                        continue  # detached tenant: the host no longer serves it
+                    host = self._hosts.get(rec.share_key)
+                    if host and host.proc is not None and host.proc.poll() is None:
+                        return host.proc.pid
+                    continue
+                if rec.proc is not None and rec.proc.poll() is None:
+                    return rec.proc.pid
+        return None
+
     # -- test helper ------------------------------------------------------
     def kill_engine_hard(self, engine_id: str) -> None:
-        """SIGKILL without touching desired state — a real crash."""
+        """SIGKILL without touching desired state — a real crash. For a
+        tenant this kills the shared HOST process (the realistic failure:
+        the chip-owning process died, taking every co-tenant with it)."""
         with self._lock:
             rec = self._require(engine_id)
-            if rec.proc is not None and rec.proc.poll() is None:
-                os.killpg(rec.proc.pid, signal.SIGKILL)
-                rec.proc.wait(timeout=5)
+            proc = rec.proc
+            if rec.share_key is not None:
+                host = self._hosts.get(rec.share_key)
+                proc = host.proc if host else None
+            if proc is not None and proc.poll() is None:
+                os.killpg(proc.pid, signal.SIGKILL)
+                proc.wait(timeout=5)

@@ -1,0 +1,131 @@
+"""Model-artifact builder (the image-builder analogue, builder.go:98-218):
+layout detection, metadata-only validation, dedup naming, and the e2e
+deploy-from-directory flow through the API."""
+
+import asyncio
+import json
+
+import pytest
+
+from agentainer_tpu.manager.artifacts import ArtifactError, ArtifactRegistry, detect_layout
+from agentainer_tpu.models.configs import get_config
+from agentainer_tpu.store import MemoryStore
+
+from .test_e2e_local import AUTH, run, start_stack, teardown
+from .test_hf_convert import _write_hf_llama
+
+
+def test_detect_layout(tmp_path):
+    assert detect_layout(tmp_path) is None  # empty dir
+    assert detect_layout(tmp_path / "missing") is None
+    _write_hf_llama(tmp_path, get_config("tiny"))
+    assert detect_layout(tmp_path) == "hf"
+    orb = tmp_path / "orb"
+    (orb / "params").mkdir(parents=True)
+    assert detect_layout(orb) == "orbax"
+
+
+def test_build_validates_and_dedups(tmp_path):
+    cfg = get_config("tiny")
+    _write_hf_llama(tmp_path, cfg)
+    reg = ArtifactRegistry(MemoryStore())
+    lines: list[str] = []
+    doc = reg.build(tmp_path, name="tiny-chat", progress=lines.append)
+    assert doc["name"] == "tiny-chat"
+    assert doc["layout"] == "hf"
+    assert doc["n_tensors"] > 0 and doc["n_params"] > 0
+    assert any("validated" in line for line in lines)
+    # duplicate name → dedup suffix (builder.go:196-218 analogue)
+    doc2 = reg.build(tmp_path, name="tiny-chat")
+    assert doc2["name"] == "tiny-chat-2"
+    assert {a["name"] for a in reg.list()} == {"tiny-chat", "tiny-chat-2"}
+    assert reg.remove("tiny-chat-2") is True
+    assert reg.remove("tiny-chat-2") is False
+
+
+def test_build_rejects_non_model_dir(tmp_path):
+    (tmp_path / "README.md").write_text("not a model")
+    reg = ArtifactRegistry(MemoryStore())
+    with pytest.raises(ArtifactError):
+        reg.build(tmp_path)
+
+
+def test_build_rejects_shape_mismatch(tmp_path):
+    cfg = get_config("tiny")
+    _write_hf_llama(tmp_path, cfg)
+    # config lies about the width → every projection's shape mismatches
+    conf = json.loads((tmp_path / "config.json").read_text())
+    conf["intermediate_size"] = conf["intermediate_size"] * 2
+    (tmp_path / "config.json").write_text(json.dumps(conf))
+    reg = ArtifactRegistry(MemoryStore())
+    with pytest.raises(ArtifactError, match="shape mismatch"):
+        reg.build(tmp_path)
+
+
+def test_deploy_from_directory_e2e(tmp_path):
+    """The full flow: register the checkpoint dir via POST /artifacts, deploy
+    an agent referencing the artifact by name, serve a /chat from the real
+    llm engine subprocess loading those weights."""
+    model_dir = tmp_path / "ckpt"
+    model_dir.mkdir()
+    _write_hf_llama(model_dir, get_config("tiny"))
+
+    async def body():
+        services, client = await start_stack(tmp_path)
+        try:
+            resp = await client.post(
+                "/artifacts", json={"path": str(model_dir), "name": "tiny-hf"}, headers=AUTH
+            )
+            assert resp.status == 200, await resp.text()
+            art = (await resp.json())["data"]
+            assert art["name"] == "tiny-hf"
+            assert art["build_log"]
+
+            resp = await client.get("/artifacts", headers=AUTH)
+            assert [a["name"] for a in (await resp.json())["data"]] == ["tiny-hf"]
+
+            resp = await client.post(
+                "/agents",
+                json={
+                    "name": "from-dir",
+                    "model": {"engine": "llm", "artifact": "tiny-hf"},
+                },
+                headers=AUTH,
+            )
+            assert resp.status == 200, await resp.text()
+            agent = (await resp.json())["data"]
+            resp = await client.post(f"/agents/{agent['id']}/start", headers=AUTH)
+            assert resp.status == 200, await resp.text()
+
+            # wait out the engine's model load (503-loading until then)
+            deadline = asyncio.get_event_loop().time() + 120
+            while True:
+                resp = await client.post(
+                    f"/agent/{agent['id']}/chat", data=json.dumps({"message": "hi"})
+                )
+                if resp.status == 200:
+                    doc = await resp.json()
+                    assert doc["response"] is not None
+                    break
+                assert asyncio.get_event_loop().time() < deadline, await resp.text()
+                await asyncio.sleep(1.0)
+        finally:
+            await teardown(services, client)
+
+    run(body())
+
+
+def test_deploy_unknown_artifact_404(tmp_path):
+    async def body():
+        services, client = await start_stack(tmp_path)
+        try:
+            resp = await client.post(
+                "/agents",
+                json={"name": "x", "model": {"engine": "llm", "artifact": "nope"}},
+                headers=AUTH,
+            )
+            assert resp.status == 404
+        finally:
+            await teardown(services, client)
+
+    run(body())

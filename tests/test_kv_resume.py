@@ -31,7 +31,7 @@ def test_snapshot_restore_resumes_identically():
     async def interrupted():
         eng1 = LLMEngine.create("tiny", options=OPTS)
         a = await eng1.chat("s", "turn one", max_tokens=5)
-        blob = eng1.snapshot_session("s")
+        blob = await eng1.snapshot_session("s")
         assert blob is not None
         eng1.shutdown()  # the crash
 
@@ -58,7 +58,7 @@ def test_restore_rejects_oversized_snapshot():
     async def body():
         eng = LLMEngine.create("tiny", options=OPTS)
         await eng.chat("s", "hello", max_tokens=4)
-        blob = eng.snapshot_session("s")
+        blob = await eng.snapshot_session("s")
         eng.shutdown()
         # an engine with a smaller arena cannot hold the snapshot -> False
         small = LLMEngine.create("tiny", options={"max_batch": 2, "max_seq": 8})
@@ -77,6 +77,6 @@ def test_restore_rejects_oversized_snapshot():
 def test_snapshot_unknown_session_is_none():
     eng = LLMEngine.create("tiny", options=OPTS)
     try:
-        assert eng.snapshot_session("nope") is None
+        assert run(eng.snapshot_session("nope")) is None
     finally:
         eng.shutdown()

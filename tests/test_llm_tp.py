@@ -84,8 +84,11 @@ def test_tp_session_snapshot_restore_roundtrip():
         async def turn(e, msg):
             return await e.chat(session="s1", message=msg, max_tokens=4)
 
-        asyncio.run(turn(engine, "first turn"))
-        blob = engine.snapshot_session("s1")
+        async def turn_and_snap(e, msg):
+            await e.chat(session="s1", message=msg, max_tokens=4)
+            return await e.snapshot_session("s1")
+
+        blob = asyncio.run(turn_and_snap(engine, "first turn"))
         assert blob
         pos = engine.slots[engine.sessions["s1"]].position
     finally:

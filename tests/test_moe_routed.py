@@ -84,10 +84,15 @@ def test_flop_model_charges_k_not_E():
 
 def test_single_chip_engine_routed_opt_in_matches_dense():
     dense = LLMEngine.create("tiny-moe", options={"max_batch": 2, "max_seq": 128})
+    from agentainer_tpu.models.configs import get_config
+
+    tm = get_config("tiny-moe")
+    # dropless capacity DERIVED from the config so greedy tokens stay
+    # comparable even if tiny-moe's E or k changes (ADVICE r4)
+    dropless_cf = tm.n_experts / tm.experts_per_token
     routed = LLMEngine.create(
         "tiny-moe",
-        # dropless capacity so greedy tokens are comparable
-        options={"max_batch": 2, "max_seq": 128, "routed": True, "moe_cf": 2.0},
+        options={"max_batch": 2, "max_seq": 128, "routed": True, "moe_cf": dropless_cf},
     )
     try:
         assert dense.routed_moe is False

@@ -66,9 +66,19 @@ def test_decode_interleaves_with_long_prefill():
         loop = asyncio.get_running_loop()
         # session A: long generation under way (decode_chunk=2 → many steps)
         task_a = loop.create_task(
-            engine.chat(session="a", message="short", max_tokens=60)
+            engine.chat(session="a", message="short", max_tokens=200)
         )
-        await asyncio.sleep(0.3)  # A is mid-decode
+        # wait until A is genuinely MID-decode (a fixed sleep races the
+        # host's speed: on a fast machine A used to finish inside it and
+        # the observation window saw no decode at all)
+        for _ in range(2000):
+            await asyncio.sleep(0.005)
+            slot_idx = engine.sessions.get("a")
+            if slot_idx is None:
+                continue
+            slot = engine.slots[slot_idx]
+            if slot.request is not None and len(slot.request.generated) >= 2:
+                break
         calls.clear()  # observe only the contended window
         # session B: long prompt → multiple prefill chunks
         task_b = loop.create_task(
@@ -78,7 +88,7 @@ def test_decode_interleaves_with_long_prefill():
 
     try:
         ra, rb = asyncio.run(scenario())
-        assert ra["completion_tokens"] == 60
+        assert ra["completion_tokens"] == 200
         assert rb["completion_tokens"] == 4
         # B's prompt took several chunks...
         assert calls.count("p") >= 2, calls

@@ -47,6 +47,11 @@ from .tokenizer import load_tokenizer
 PREFILL_BUCKETS = (32, 64, 128, 256, 512, 1024)
 
 
+class SnapshotDeferred(Exception):
+    """KV snapshot postponed: the engine is busy (or the global limiter is
+    saturated) and durability is not yet overdue. Retry on a later turn."""
+
+
 def _sharded_random_init(cfg: ModelConfig, dtype, mesh, specs: dict) -> dict:
     """Random-init DIRECTLY into shards: ``jit(init, out_shardings=...)``
     makes every chip allocate only its own slice of every weight, so a
@@ -318,10 +323,15 @@ class LLMEngine:
         self.cache_resets = 0
         self._snap_fns: dict[int, Any] = {}
         # global limiter: one snapshot staging per gap — the readback rides
-        # the same device stream decode lives on, so unthrottled snapshots
+        # the same device stream decode lives on (a bucket-128 8B snapshot
+        # measured ~1.25s of tunnel readback), so unthrottled snapshots
         # from many sessions at once would tax every in-flight generation
-        self.snapshot_min_gap_s = 1.0
-        self._last_snapshot_at = 0.0
+        self.snapshot_min_gap_s = 2.0
+        # busy engines defer snapshots to idle moments, but never longer
+        # than this (durability floor under sustained load)
+        self.snapshot_force_s = 30.0
+        # gap-free first snapshot, but the force timer starts fresh
+        self._last_snapshot_at = time.monotonic() - self.snapshot_min_gap_s
         self._prefilling_slot: Slot | None = None
         # HBM traffic model for MBU (decode is memory-bound; MFU alone
         # judges it against the wrong roofline — VERDICT r4 item 6): every
@@ -463,7 +473,8 @@ class LLMEngine:
                 devices=devices,
                 mesh=mesh,
             )
-            engine.warmup()
+            if not options.get("skip_warmup"):
+                engine.warmup()
             return engine
         # sequence parallelism is opt-in (long-context serving); requested
         # sp reserves its chips before the tp/ep split
@@ -581,8 +592,13 @@ class LLMEngine:
             moe_capacity_factor=float(options.get("moe_cf", 2.0)),
         )
         # pay the decode/prefill compiles here (inside the loader thread, while
-        # /health keeps answering) instead of on the first user request
-        engine.warmup()
+        # /health keeps answering) instead of on the first user request.
+        # skip_warmup (set on engine RESPAWN when the persistent XLA cache is
+        # already populated) trades a few cache-load hiccups on the first
+        # requests for a much shorter crash-recovery time — the compiles are
+        # disk loads, not recompiles.
+        if not options.get("skip_warmup"):
+            engine.warmup()
         return engine
 
     def _build_compiled(self) -> None:
@@ -843,7 +859,12 @@ class LLMEngine:
             if staged != "rate-limited":
                 break
             await asyncio.sleep(self.snapshot_min_gap_s)
-        if staged is None or staged == "rate-limited":
+        if staged == "rate-limited":
+            # distinguishable give-up: the caller decides whether to retry
+            # later or surface it — silently returning None here would be
+            # indistinguishable from "session has nothing to save"
+            raise SnapshotDeferred(session)
+        if staged is None:
             return None
         k16, v16, position, pending_token = staged
         from .checkpoint import pack_kv_snapshot
@@ -863,9 +884,18 @@ class LLMEngine:
         staged = None
         idx = self.sessions.get(cmd.session)
         now = time.monotonic()
+        busy = any(s.decoding or s.pending_prompt for s in self.slots)
+        overdue = now - self._last_snapshot_at >= self.snapshot_force_s
         if idx is not None and now - self._last_snapshot_at < self.snapshot_min_gap_s:
             # distinguishable from "nothing to save": the caller retries
             # after the gap so a burst's trailing capture is never dropped
+            staged = "rate-limited"
+        elif idx is not None and busy and not overdue:
+            # idle-preferred: a snapshot's device→host readback serializes
+            # with decode on the device link (measured ~1.25s for an 8B
+            # bucket-128 blob over the tunnel) — taking it mid-decode taxes
+            # every in-flight generation. Defer while the engine is busy,
+            # unless durability is overdue (snapshot_force_s).
             staged = "rate-limited"
         elif idx is not None:
             slot = self.slots[idx]
@@ -1100,7 +1130,10 @@ class LLMEngine:
         slot.pending_token = None
         slot.epoch += 1
         if slot.session:
-            self.sessions.pop(slot.session, None)
+            # only drop the mapping if it still points HERE — clear_sessions
+            # may have already remapped this session name to another slot
+            if self.sessions.get(slot.session) == slot.idx:
+                self.sessions.pop(slot.session, None)
             slot.session = ""
 
     def _ensure_device_state(self) -> None:
@@ -1197,7 +1230,7 @@ class LLMEngine:
             return None
         fresh = [s for s in idle if not s.session]
         slot = fresh[0] if fresh else min(idle, key=lambda s: s.last_used)
-        if slot.session:
+        if slot.session and self.sessions.get(slot.session) == slot.idx:
             self.sessions.pop(slot.session, None)  # evict LRU session's KV
         slot.session = session
         slot.position = 0
@@ -1343,13 +1376,20 @@ class LLMEngine:
         self._readbacks.append(("chunk", snapshot, toks, time.monotonic()))
 
     def _drain_readbacks(self, block: bool) -> None:
-        """Process landed readbacks in FIFO order. ``block`` forces the
-        OLDEST entry to completion (pipeline backpressure); later entries
-        are only consumed if their copies already landed."""
+        """Process landed readbacks in FIFO order. An entry is forced to
+        completion when ``block`` asks for one (idle drain) or whenever the
+        queue is deeper than the pipeline depth — the queue must NEVER grow
+        past depth+1, or every response is delivered queue-length × chunk
+        wall LATE. (Round-5 hardware run: one forced drain per iteration
+        while prefill turns appended two entries grew the queue to ~40 —
+        admission was 160 ms but TTFT read 6 s, all of it delivery lag.
+        The non-blocking is_ready() path never fires on the axon tunnel,
+        which can't poll readiness, so the length bound is the only
+        effective backpressure there.)"""
         while self._readbacks:
             entry = self._readbacks[0]
             arr = entry[3] if entry[0] == "first" else entry[2]
-            if not block:
+            if not (block or len(self._readbacks) > self._PIPELINE_DEPTH):
                 try:
                     if not arr.is_ready():
                         return

@@ -91,6 +91,7 @@ class LLMServeApp:
         self._host_token = E.get("AGENTAINER_HOST_TOKEN", "")
         self.kv_restores = 0
         self.kv_snapshots = 0
+        self.kv_snapshots_deferred = 0
         self.kv_snapshot_errors = 0
         self.last_kv_snapshot_error = ""
         # debounce: at most one snapshot per session per interval, with a
@@ -163,12 +164,19 @@ class LLMServeApp:
         await self._snapshot_now(session)
 
     async def _snapshot_now(self, session: str) -> None:
+        from .llm import SnapshotDeferred
+
         try:
             blob = await self.engine.snapshot_session(self._sess(session))
             if blob:
                 self._kv_last_snap[session] = time.monotonic()
                 await self.store.set_bytes(self._kv_key(session), blob, ttl=24 * 3600)
                 self.kv_snapshots += 1
+        except SnapshotDeferred:
+            # engine busy / limiter saturated: not an error — the next turn
+            # retries, and the engine's snapshot_force_s bounds how long a
+            # loaded engine can keep deferring. Counted for observability.
+            self.kv_snapshots_deferred += 1
         except Exception as e:
             # surfaced, not swallowed: /metrics carries the count + last error
             self.kv_snapshot_errors += 1
@@ -182,6 +190,17 @@ class LLMServeApp:
             # split from the chip budget itself (dense → tp-first, MoE →
             # ep-first), and an explicit options.tp/ep/sp only narrows it
             opts["chips"] = list(self.chips)
+        # warm boot (engine RESPAWN with a populated persistent XLA cache):
+        # skip the serving warmup — every compile it would trigger is a disk
+        # cache load that the first real requests absorb in milliseconds,
+        # and skipping it is most of the crash-recovery win (VERDICT r4 #4)
+        if os.environ.get("AGENTAINER_WARM_BOOT") == "1" and "skip_warmup" not in opts:
+            cache_dir = os.environ.get("AGENTAINER_COMPILE_CACHE", "")
+            try:
+                if cache_dir and any(os.scandir(cache_dir)):
+                    opts["skip_warmup"] = True
+            except OSError:
+                pass
         return opts
 
     def _load_engine(self) -> None:
@@ -658,6 +677,7 @@ class LLMServeApp:
             "model_loaded": self.engine is not None,
             "engine_error": self.engine_error or None,
             "kv_snapshots": self.kv_snapshots,
+            "kv_snapshots_deferred": self.kv_snapshots_deferred,
             "kv_restores": self.kv_restores,
             "kv_snapshot_errors": self.kv_snapshot_errors,
             "last_kv_snapshot_error": self.last_kv_snapshot_error or None,

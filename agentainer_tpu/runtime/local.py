@@ -306,6 +306,9 @@ class LocalBackend(Backend):
         host.log_file = open(host.log_path, "ab")
         host.env["AGENTAINER_CONTROL_URL"] = self.control_url
         host.env["AGENTAINER_STORE_SOCK"] = self.store_sock
+        if host.proc is not None:
+            # respawn after a host death: warm XLA cache → skip warmup
+            host.env["AGENTAINER_WARM_BOOT"] = "1"
         host.proc = subprocess.Popen(
             [self.python, "-m", "agentainer_tpu.runtime.engine_main"],
             env=host.env,
@@ -428,6 +431,10 @@ class LocalBackend(Backend):
         rec.log_file = open(rec.log_path, "ab")
         rec.env["AGENTAINER_CONTROL_URL"] = self.control_url
         rec.env["AGENTAINER_STORE_SOCK"] = self.store_sock
+        if rec.proc is not None or rec.restarts:
+            # respawn: the persistent XLA cache is warm — the engine may
+            # skip its warmup serving pass (recovery-time win)
+            rec.env["AGENTAINER_WARM_BOOT"] = "1"
         rec.proc = subprocess.Popen(
             rec.cmd,
             env=rec.env,
@@ -589,15 +596,7 @@ class LocalBackend(Backend):
         return None if rec is None else str(rec.log_path)
 
     def _tail_log(self, rec: _EngineRec, tail: int) -> list[str]:
-        try:
-            with open(rec.log_path, "rb") as f:
-                f.seek(0, os.SEEK_END)
-                size = f.tell()
-                f.seek(max(0, size - 256 * 1024))
-                lines = f.read().decode("utf-8", "replace").splitlines()
-            return lines[-tail:]
-        except OSError:
-            return []
+        return self._tail_path(rec.log_path, tail)
 
     def stats(self, engine_id: str) -> dict | None:
         """Pull serving counters from the engine's /metrics (the

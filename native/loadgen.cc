@@ -1,0 +1,193 @@
+// Native HTTP load generator for the proxy benchmark.
+//
+// The bench host is a 1-core VM: the Python asyncio load generators burned
+// most of the core the C++ data plane needed, so the measured req/s was
+// bounded by the GENERATOR, not the system under test. This is a minimal
+// single-threaded poll() loop over N keep-alive connections issuing
+// POST {path} with a fixed JSON body and parsing Content-Length responses —
+// a few microseconds of CPU per request instead of Python's hundreds.
+//
+// Usage: loadgen HOST PORT PATH N_REQUESTS N_CONNS
+// Prints one JSON line: {"n":..,"wall_s":..,"p50_ms":..,"p99_ms":..}
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cerrno>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Conn {
+  int fd = -1;
+  size_t sent = 0;         // bytes of the current request written
+  std::string inbuf;       // response bytes accumulated
+  size_t need = 0;         // body bytes still expected (0 = parsing headers)
+  bool headers_done = false;
+  Clock::time_point t0;
+  bool in_flight = false;
+};
+
+int connect_nonblock(const char* host, int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    close(fd);
+    return -1;
+  }
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    close(fd);
+    return -1;
+  }
+  fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+  return fd;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 6) {
+    fprintf(stderr, "usage: %s HOST PORT PATH N_REQUESTS N_CONNS\n", argv[0]);
+    return 2;
+  }
+  const char* host = argv[1];
+  int port = atoi(argv[2]);
+  std::string path = argv[3];
+  long total = atol(argv[4]);
+  int n_conns = atoi(argv[5]);
+  if (total <= 0 || n_conns <= 0) return 2;
+
+  const std::string body = "{\"message\": \"bench\"}";
+  char reqbuf[512];
+  int reqlen = snprintf(reqbuf, sizeof(reqbuf),
+                        "POST %s HTTP/1.1\r\nHost: %s\r\n"
+                        "Content-Type: application/json\r\n"
+                        "Content-Length: %zu\r\nConnection: keep-alive\r\n\r\n%s",
+                        path.c_str(), host, body.size(), body.c_str());
+
+  std::vector<Conn> conns(static_cast<size_t>(n_conns));
+  for (auto& c : conns) {
+    c.fd = connect_nonblock(host, port);
+    if (c.fd < 0) {
+      fprintf(stderr, "connect failed: %s\n", strerror(errno));
+      return 1;
+    }
+  }
+
+  std::vector<double> lat_ms;
+  lat_ms.reserve(static_cast<size_t>(total));
+  long started = 0, done = 0;
+  std::vector<pollfd> pfds(conns.size());
+  auto wall0 = Clock::now();
+
+  while (done < total) {
+    for (size_t i = 0; i < conns.size(); ++i) {
+      Conn& c = conns[i];
+      if (!c.in_flight && started < total) {
+        c.in_flight = true;
+        c.sent = 0;
+        c.inbuf.clear();
+        c.need = 0;
+        c.headers_done = false;
+        c.t0 = Clock::now();
+        ++started;
+      }
+      pfds[i].fd = c.fd;
+      pfds[i].events = 0;
+      if (c.in_flight) {
+        if (c.sent < static_cast<size_t>(reqlen)) pfds[i].events |= POLLOUT;
+        pfds[i].events |= POLLIN;
+      }
+    }
+    int rc = poll(pfds.data(), pfds.size(), 5000);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      fprintf(stderr, "poll: %s\n", strerror(errno));
+      return 1;
+    }
+    for (size_t i = 0; i < conns.size(); ++i) {
+      Conn& c = conns[i];
+      if (!c.in_flight) continue;
+      if ((pfds[i].revents & POLLOUT) && c.sent < static_cast<size_t>(reqlen)) {
+        ssize_t n = write(c.fd, reqbuf + c.sent, static_cast<size_t>(reqlen) - c.sent);
+        if (n > 0) c.sent += static_cast<size_t>(n);
+        else if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK) {
+          fprintf(stderr, "write: %s\n", strerror(errno));
+          return 1;
+        }
+      }
+      if (pfds[i].revents & (POLLIN | POLLERR | POLLHUP)) {
+        char buf[8192];
+        ssize_t n = read(c.fd, buf, sizeof(buf));
+        if (n == 0) {
+          fprintf(stderr, "server closed connection\n");
+          return 1;
+        }
+        if (n < 0) {
+          if (errno == EAGAIN || errno == EWOULDBLOCK) continue;
+          fprintf(stderr, "read: %s\n", strerror(errno));
+          return 1;
+        }
+        c.inbuf.append(buf, static_cast<size_t>(n));
+        if (!c.headers_done) {
+          size_t hdr_end = c.inbuf.find("\r\n\r\n");
+          if (hdr_end == std::string::npos) continue;
+          if (c.inbuf.compare(0, 12, "HTTP/1.1 200") != 0) {
+            fprintf(stderr, "bad status: %.64s\n", c.inbuf.c_str());
+            return 1;
+          }
+          size_t cl = 0;
+          // case-insensitive Content-Length scan within the header block
+          for (size_t p = 0; p + 16 < hdr_end;) {
+            size_t eol = c.inbuf.find("\r\n", p);
+            if (eol == std::string::npos || eol > hdr_end) break;
+            if (strncasecmp(c.inbuf.c_str() + p, "content-length:", 15) == 0)
+              cl = strtoul(c.inbuf.c_str() + p + 15, nullptr, 10);
+            p = eol + 2;
+          }
+          c.headers_done = true;
+          size_t have = c.inbuf.size() - (hdr_end + 4);
+          c.need = (cl > have) ? cl - have : 0;
+        } else {
+          size_t got = static_cast<size_t>(n);
+          c.need = (c.need > got) ? c.need - got : 0;
+        }
+        if (c.headers_done && c.need == 0) {
+          double ms = std::chrono::duration<double, std::milli>(Clock::now() - c.t0).count();
+          lat_ms.push_back(ms);
+          c.in_flight = false;
+          ++done;
+        }
+      }
+    }
+  }
+
+  double wall = std::chrono::duration<double>(Clock::now() - wall0).count();
+  std::sort(lat_ms.begin(), lat_ms.end());
+  auto pct = [&](double p) {
+    size_t idx = static_cast<size_t>(p * (lat_ms.size() - 1));
+    return lat_ms.empty() ? 0.0 : lat_ms[idx];
+  };
+  printf("{\"n\": %ld, \"wall_s\": %.4f, \"p50_ms\": %.3f, \"p99_ms\": %.3f}\n",
+         done, wall, pct(0.5), pct(0.99));
+  for (auto& c : conns) close(c.fd);
+  return 0;
+}

@@ -193,15 +193,34 @@ class LLMServeApp:
         # warm boot (engine RESPAWN with a populated persistent XLA cache):
         # skip the serving warmup — every compile it would trigger is a disk
         # cache load that the first real requests absorb in milliseconds,
-        # and skipping it is most of the crash-recovery win (VERDICT r4 #4)
+        # and skipping it is most of the crash-recovery win (VERDICT r4 #4).
+        # Gated on a marker proving THIS engine configuration completed a
+        # warmup into the cache before — a dir holding only some other
+        # model's entries would silently reintroduce full first-request
+        # compiles on the recovery path.
         if os.environ.get("AGENTAINER_WARM_BOOT") == "1" and "skip_warmup" not in opts:
-            cache_dir = os.environ.get("AGENTAINER_COMPILE_CACHE", "")
-            try:
-                if cache_dir and any(os.scandir(cache_dir)):
-                    opts["skip_warmup"] = True
-            except OSError:
-                pass
+            marker = self._warm_marker_path(opts)
+            if marker and os.path.exists(marker):
+                opts["skip_warmup"] = True
         return opts
+
+    def _warm_marker_path(self, opts: dict) -> str:
+        cache_dir = os.environ.get("AGENTAINER_COMPILE_CACHE", "")
+        if not cache_dir:
+            return ""
+        import hashlib
+
+        key = json.dumps(
+            {
+                "config": self.config_name,
+                "checkpoint": self.checkpoint,
+                "opts": {k: v for k, v in sorted(opts.items()) if k != "skip_warmup"},
+            },
+            sort_keys=True,
+        )
+        return os.path.join(
+            cache_dir, f"warmed-{hashlib.sha1(key.encode()).hexdigest()[:16]}"
+        )
 
     def _load_engine(self) -> None:
         """Build the JAX engine (slow: compile + weight init). Runs in a
@@ -209,6 +228,7 @@ class LLMServeApp:
         try:
             from .llm import LLMEngine
 
+            opts = self._engine_options()
             self.engine = LLMEngine.create(
                 config_name=self.config_name,
                 checkpoint=self.checkpoint,
@@ -217,8 +237,18 @@ class LLMServeApp:
                 # deploy-time knobs (quant/max_batch/…); the scheduler's
                 # chip assignment always rides along (placement authority),
                 # while an explicit options.tp can narrow the span
-                options=self._engine_options(),
+                options=opts,
             )
+            if not opts.get("skip_warmup"):
+                # record that THIS configuration's warmup populated the
+                # persistent cache — the respawn fast path keys on it
+                marker = self._warm_marker_path(opts)
+                if marker:
+                    try:
+                        with open(marker, "w") as f:
+                            f.write("ok")
+                    except OSError:
+                        pass
         except BaseException as e:  # engine stays None; /chat reports 503
             self.engine_error = f"{type(e).__name__}: {e}"
 

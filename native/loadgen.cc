@@ -97,8 +97,15 @@ int main(int argc, char** argv) {
   long started = 0, done = 0;
   std::vector<pollfd> pfds(conns.size());
   auto wall0 = Clock::now();
+  auto last_progress = wall0;
 
   while (done < total) {
+    // stall watchdog: a dropped response must not spin this loop until the
+    // caller's subprocess timeout — fail fast so the bench can fall back
+    if (std::chrono::duration<double>(Clock::now() - last_progress).count() > 30.0) {
+      fprintf(stderr, "no progress for 30s (%ld/%ld done)\n", done, total);
+      return 1;
+    }
     for (size_t i = 0; i < conns.size(); ++i) {
       Conn& c = conns[i];
       if (!c.in_flight && started < total) {
@@ -138,8 +145,19 @@ int main(int argc, char** argv) {
         char buf[8192];
         ssize_t n = read(c.fd, buf, sizeof(buf));
         if (n == 0) {
-          fprintf(stderr, "server closed connection\n");
-          return 1;
+          // server closed the keep-alive (idle timeout / graceful restart):
+          // reconnect this connection and resend the in-flight request
+          close(c.fd);
+          c.fd = connect_nonblock(host, port);
+          if (c.fd < 0) {
+            fprintf(stderr, "reconnect failed: %s\n", strerror(errno));
+            return 1;
+          }
+          c.sent = 0;
+          c.inbuf.clear();
+          c.headers_done = false;
+          c.need = 0;
+          continue;
         }
         if (n < 0) {
           if (errno == EAGAIN || errno == EWOULDBLOCK) continue;
@@ -155,13 +173,23 @@ int main(int argc, char** argv) {
             return 1;
           }
           size_t cl = 0;
+          bool cl_found = false;
           // case-insensitive Content-Length scan within the header block
           for (size_t p = 0; p + 16 < hdr_end;) {
             size_t eol = c.inbuf.find("\r\n", p);
             if (eol == std::string::npos || eol > hdr_end) break;
-            if (strncasecmp(c.inbuf.c_str() + p, "content-length:", 15) == 0)
+            if (strncasecmp(c.inbuf.c_str() + p, "content-length:", 15) == 0) {
               cl = strtoul(c.inbuf.c_str() + p + 15, nullptr, 10);
+              cl_found = true;
+            }
             p = eol + 2;
+          }
+          if (!cl_found) {
+            // chunked/close-delimited bodies would desync the keep-alive
+            // stream — refuse loudly instead of corrupting every later
+            // sample on this connection
+            fprintf(stderr, "response without Content-Length (unsupported)\n");
+            return 1;
           }
           c.headers_done = true;
           size_t have = c.inbuf.size() - (hdr_end + 4);
@@ -175,6 +203,7 @@ int main(int argc, char** argv) {
           lat_ms.push_back(ms);
           c.in_flight = false;
           ++done;
+          last_progress = Clock::now();
         }
       }
     }

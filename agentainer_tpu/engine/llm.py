@@ -1445,6 +1445,9 @@ class LLMEngine:
         The non-blocking is_ready() path never fires on the axon tunnel,
         which can't poll readiness, so the length bound is the only
         effective backpressure there.)"""
+        # (Eager out-of-band delivery of first-token entries was tried and
+        # reverted: it blocks the worker on an extra fetch per prefill for
+        # a TTFT change inside run-to-run noise, at ~7% decode throughput.)
         while self._readbacks:
             entry = self._readbacks[0]
             arr = entry[3] if entry[0] == "first" else entry[2]

@@ -304,3 +304,39 @@ def test_logs_follow_streams_new_lines(tmp_path):
             await teardown(services, client)
 
     run(body())
+
+
+def test_host_process_metrics(tmp_path):
+    """Per-engine host CPU%/RSS from /proc (the ContainerStats CPU/mem half,
+    reference pkg/metrics/collector.go:249-298)."""
+
+    async def body():
+        services, client = await start_stack(tmp_path)
+        backend = services.backend
+        try:
+            resp = await client.post(
+                "/agents", json={"name": "hm", "model": "echo"}, headers=AUTH
+            )
+            agent = (await resp.json())["data"]
+            resp = await client.post(f"/agents/{agent['id']}/start", headers=AUTH)
+            assert resp.status == 200, await resp.text()
+            eid = services.manager.get_agent(agent["id"]).engine_id
+
+            first = backend.host_stats(eid)
+            assert first is not None
+            assert first["pid"] > 0
+            assert first["host_rss_bytes"] > 1024 * 1024  # a live python proc
+            assert first["host_cpu_pct"] is None  # no delta on the first sample
+            await asyncio.sleep(0.2)
+            second = backend.host_stats(eid)
+            assert second["host_cpu_pct"] is not None
+            assert second["host_cpu_pct"] >= 0.0
+
+            # the metrics plane folds it into the agent sample
+            sample = services.metrics.sample_agent(agent["id"])
+            assert "host" in sample
+            assert sample["host"]["host_rss_bytes"] > 0
+        finally:
+            await teardown(services, client)
+
+    run(body())

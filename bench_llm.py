@@ -276,7 +276,22 @@ async def _drive_tier(
             assert r["status"] == 200, r
             lat.append(time.monotonic() - s)
 
-    await asyncio.gather(*(drive(i) for i in range(SESSIONS)))
+    drivers = [asyncio.ensure_future(drive(i)) for i in range(SESSIONS)]
+    profile_dir = None
+    if os.environ.get("ATPU_BENCH_PROFILE", "0") == "1":
+        # capture a jax.profiler trace WHILE the measured load runs — the
+        # tracing plane is only proven if it works under real traffic
+        await asyncio.sleep(2.0)
+        async with session.post(
+            f"/agents/{aid}/profile", json={"duration_s": 2.0}, headers=auth
+        ) as resp:
+            doc = await resp.json(content_type=None)
+            if resp.status == 200:
+                profile_dir = (doc.get("data") or {}).get("trace_dir")
+                log(f"profile trace captured: {profile_dir}")
+            else:
+                log(f"profile capture failed: {doc}")
+    await asyncio.gather(*drivers)
     wall = time.monotonic() - t0
     m1 = await _metrics(session, aid)
 
@@ -362,6 +377,7 @@ async def _drive_tier(
         "requests": len(lat),
         "engine_load_s": round(load_s, 1),
         "hbm_bytes_per_chip": m1.get("hbm_bytes_per_chip_est"),
+        **({"profile_trace_dir": profile_dir} if profile_dir else {}),
         **sat,
     }
     log(f"llm bench: {json.dumps(llm)}")

@@ -80,3 +80,31 @@ def test_snapshot_unknown_session_is_none():
         assert run(eng.snapshot_session("nope")) is None
     finally:
         eng.shutdown()
+
+
+def test_snapshot_bucket_beyond_1024():
+    """Long-context sessions past the 1024 prefill-bucket cap must snapshot
+    their FULL prefix — the slicer bucket grows by powers of two up to
+    max_seq (a cap at the prefill buckets' top silently truncated tails)."""
+    eng = LLMEngine.create(
+        "tiny", options={"max_batch": 2, "max_seq": 4096, "prefill_chunk": 512}
+    )
+    try:
+        # fallback tokenizer is byte-level: 250 x "word " ≈ 1250 tokens —
+        # past the 1024 bucket cap but well inside the 4096 arena
+        long_prompt = "word " * 250
+
+        async def go():
+            await eng.chat(session="lc", message=long_prompt, max_tokens=4)
+            pos = eng.slots[eng.sessions["lc"]].position
+            assert pos > 1024, pos
+            assert eng._snap_bucket(pos) >= pos
+            blob = await eng.snapshot_session("lc")
+            assert blob is not None
+            k, v, header = deserialize_kv_slot(blob)
+            assert header["position"] == pos == k.shape[1]
+            assert await eng.restore_session("lc2", blob) is True
+
+        run(go())
+    finally:
+        eng.shutdown()

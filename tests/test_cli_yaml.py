@@ -166,3 +166,54 @@ def test_cli_help_runs():
     assert out.returncode == 0
     for verb in ("deploy", "start", "stop", "pause", "resume", "backup", "audit", "invoke"):
         assert verb in out.stdout
+
+
+def test_cli_deploy_model_dir_and_models_verbs(tmp_path):
+    """CLI e2e for the builder flow: `deploy --model-dir` validates +
+    registers + deploys; `models` lists the artifact (builder.go:98-218 +
+    main.go:404-443 progress UX analogue)."""
+    import asyncio
+
+    from .test_e2e_local import start_stack, teardown
+    from .test_hf_convert import _write_hf_llama
+    from agentainer_tpu.models.configs import get_config
+
+    model_dir = tmp_path / "ckpt"
+    model_dir.mkdir()
+    _write_hf_llama(model_dir, get_config("tiny"))
+
+    async def body():
+        services, client = await start_stack(tmp_path)
+        try:
+            base = ["--server", f"http://127.0.0.1:{client.server.port}", "--token", "e2e-token"]
+
+            def cli(*argv):
+                return subprocess.run(
+                    [sys.executable, "-m", "agentainer_tpu.cli", *base, *argv],
+                    capture_output=True,
+                    text=True,
+                    timeout=120,
+                    env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+                )
+
+            deploy = await asyncio.to_thread(
+                cli, "deploy", "--name", "cli-model", "--model-dir", str(model_dir)
+            )
+            assert deploy.returncode == 0, deploy.stderr
+            assert "validated" in deploy.stdout  # build progress lines shown
+            assert "built artifact 'cli-model'" in deploy.stdout
+            assert "deployed cli-model" in deploy.stdout
+
+            models = await asyncio.to_thread(cli, "models")
+            assert models.returncode == 0, models.stderr
+            assert "cli-model" in models.stdout and "hf" in models.stdout
+
+            # the deployed agent references the registered checkpoint
+            agents = services.manager.list_agents(sync_first=False)
+            agent = next(a for a in agents if a.name == "cli-model")
+            assert agent.model.checkpoint == str(model_dir.resolve())
+            assert agent.model.engine == "llm"
+        finally:
+            await teardown(services, client)
+
+    asyncio.run(body())

@@ -110,10 +110,39 @@ async def _metrics(session, agent_id: str) -> dict:
         return await resp.json()
 
 
+def _tpu_preflight(timeout_s: float) -> str | None:
+    """Probe the TPU runtime in a THROWAWAY subprocess with a hard bound.
+
+    The tunnel to the chip can wedge (a client killed mid-remote-compile
+    blocks the session claim for a long time); without this check every
+    tier would burn its full load deadline hanging in jax init and then
+    SIGKILL the stuck engine — deepening the wedge. Returns an error
+    string, or None when the chip answers."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return f"TPU runtime unreachable: jax.devices() hung for {timeout_s:.0f}s (tunnel wedged?)"
+    if proc.returncode != 0:
+        return f"TPU runtime init failed: {proc.stderr.strip()[-300:]}"
+    return None
+
+
 async def run() -> dict:
     from agentainer_tpu.config import Config
     from agentainer_tpu.daemon import build_services, run_daemon
     from agentainer_tpu.runtime.local import LocalBackend
+
+    err = _tpu_preflight(float(os.environ.get("ATPU_BENCH_PREFLIGHT_S", "180")))
+    if err is not None:
+        log(f"preflight failed: {err}")
+        return {"error": err, "preflight_failed": True}
 
     tmp = tempfile.mkdtemp(prefix="atpu-benchllm-")
     cfg = Config()

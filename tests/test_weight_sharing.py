@@ -145,3 +145,48 @@ def test_host_crash_takes_tenants_down_and_restart_recovers(tmp_path):
             await teardown(services, client)
 
     run(body())
+
+
+def test_mixed_flavors_share_one_host(tmp_path):
+    """The llm flavor and the assistant (persona) flavor of the same model
+    config share one engine process — persona knobs are serve-level and
+    must not fragment the weight share (examples/two-personas.yaml)."""
+
+    async def body():
+        services, client = await start_stack(tmp_path)
+        backend = services.backend
+        try:
+            resp = await client.post(
+                "/agents",
+                json={"name": "chat", "model": {"engine": "llm", "config": "tiny"}},
+                headers=AUTH,
+            )
+            a = (await resp.json())["data"]
+            resp = await client.post(
+                "/agents",
+                json={
+                    "name": "sage",
+                    "model": {
+                        "engine": "assistant",
+                        "config": "tiny",
+                        "options": {"system_prompt": "You are Sage.", "history_turns": 3},
+                    },
+                },
+                headers=AUTH,
+            )
+            b = (await resp.json())["data"]
+            for agent in (a, b):
+                resp = await client.post(f"/agents/{agent['id']}/start", headers=AUTH)
+                assert resp.status == 200, await resp.text()
+
+            assert backend.engine_pid(a["id"]) == backend.engine_pid(b["id"])
+
+            ra = await _chat_until_loaded(client, a["id"], "hello chat")
+            rb = await _chat_until_loaded(client, b["id"], "hello sage")
+            assert ra["agent"] == "chat"
+            # assistant flavor reports its persona in the response envelope
+            assert rb["agent"] == "sage" and rb.get("persona") == "You are Sage."
+        finally:
+            await teardown(services, client)
+
+    run(body())

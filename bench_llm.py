@@ -125,7 +125,15 @@ def _tpu_preflight(timeout_s: float) -> str | None:
     for attempt in range(tries):
         try:
             proc = subprocess.run(
-                [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+                [
+                    sys.executable,
+                    "-c",
+                    # EXECUTE and FETCH, not just list devices: on the
+                    # tunneled backend device enumeration can succeed
+                    # without touching the session claim that real compute
+                    # needs — only a forced value fetch proves the chip
+                    "import jax, jax.numpy as jnp; print(float(jnp.add(1, 1)))",
+                ],
                 capture_output=True,
                 text=True,
                 timeout=timeout_s,

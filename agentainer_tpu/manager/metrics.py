@@ -110,7 +110,13 @@ class MetricsPlane:
         if agent.engine_id:
             engine_stats = self.manager.backend.stats(agent.engine_id)
             if engine_stats:
-                sample["engine"] = engine_stats
+                # the raw percentile-window arrays (ttft_samples etc., 256
+                # floats each) belong to the live engine endpoint — persisted
+                # into every 10s history entry they'd bloat the store by
+                # ~15KB/sample (~130MB/day/agent) for no query value
+                sample["engine"] = {
+                    k: v for k, v in engine_stats.items() if not k.endswith("_samples")
+                }
             # host-process half of the picture (CPU%/RSS via /proc): on a
             # TPU-VM the host side is what throttles serving
             if hasattr(self.manager.backend, "host_stats"):

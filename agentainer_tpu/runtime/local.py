@@ -319,17 +319,7 @@ class LocalBackend(Backend):
         return host
 
     def _wait_host(self, host: _HostRec) -> None:
-        deadline = time.time() + self.ready_timeout_s
-        while time.time() < deadline:
-            if host.proc is None or host.proc.poll() is not None:
-                raise RuntimeError(
-                    f"model host for {host.key[0]!r} exited during startup; "
-                    f"log tail: {self._tail_path(host.log_path, 20)}"
-                )
-            if self._probe(host.port, timeout=1.0):
-                return
-            time.sleep(0.05)
-        raise RuntimeError(f"model host not ready after {self.ready_timeout_s}s")
+        self._wait_port(host.proc, host.port, host.log_path, f"model host {host.key[0]!r}")
 
     def _host_request(
         self, host: _HostRec, method: str, path: str, body: dict | None = None
@@ -448,17 +438,19 @@ class LocalBackend(Backend):
         """Block until the engine answers /health (containers have no such
         gate in the reference; engines do because JAX init takes seconds and
         a 'started' engine should be servable)."""
+        self._wait_port(rec.proc, rec.port, rec.log_path, f"engine {rec.engine_id}")
+
+    def _wait_port(self, proc, port: int, log_path: Path, label: str) -> None:
         deadline = time.time() + self.ready_timeout_s
         while time.time() < deadline:
-            if rec.proc is None or rec.proc.poll() is not None:
+            if proc is None or proc.poll() is not None:
                 raise RuntimeError(
-                    f"engine {rec.engine_id} exited during startup; "
-                    f"log: {self._tail_log(rec, 20)}"
+                    f"{label} exited during startup; log: {self._tail_path(log_path, 20)}"
                 )
-            if self._probe(rec.port, timeout=1.0):
+            if self._probe(port, timeout=1.0):
                 return
             time.sleep(0.05)
-        raise RuntimeError(f"engine {rec.engine_id} not ready after {self.ready_timeout_s}s")
+        raise RuntimeError(f"{label} not ready after {self.ready_timeout_s}s")
 
     def stop_engine(self, engine_id: str, timeout_s: float = 10.0) -> None:
         with self._lock:

@@ -1312,7 +1312,14 @@ class LLMEngine:
         slots = [s for s in self.slots if s.request is not None and s.pending_prompt]
         if not slots:
             return
-        slot = min(slots, key=lambda s: s.request.submitted_at)
+        # admission-first: a prompt that has not started prefilling yet beats
+        # an in-progress prompt's next chunk, so one long prompt cannot
+        # monopolize the tick and push new arrivals' admission latency to
+        # its full prefill time; ties (and steady state) stay FIFO
+        slot = min(
+            slots,
+            key=lambda s: (s.request.prefill_started_at is not None, s.request.submitted_at),
+        )
         self._prefilling_slot = slot  # fault attribution (worker loop)
         req = slot.request
         if req.prefill_started_at is None:

@@ -126,3 +126,39 @@ def test_queued_prefills_dont_compound():
         assert engine.prefills == 3
     finally:
         engine.shutdown()
+
+
+def test_new_arrival_admits_ahead_of_long_prefill():
+    """A prompt arriving while a long prompt is mid-prefill gets its FIRST
+    chunk before the long prompt's next chunk — admission latency is
+    bounded by one chunk, not by the longest prompt in flight."""
+    engine = _mk(prefill_chunk=32)
+
+    async def scenario():
+        loop = asyncio.get_running_loop()
+        big = " ".join(f"tok{i}" for i in range(400))  # many 32-token chunks
+        task_a = loop.create_task(engine.chat(session="a", message=big, max_tokens=2))
+        # wait until A's prefill has started but is far from done
+        for _ in range(2000):
+            await asyncio.sleep(0.002)
+            idx = engine.sessions.get("a")
+            if idx is not None and engine.slots[idx].request is not None and engine.slots[
+                idx
+            ].request.prefill_started_at is not None:
+                break
+        t0 = time.monotonic()
+        rb = await engine.chat(session="b", message="quick question", max_tokens=2)
+        b_wall = time.monotonic() - t0
+        ra = await task_a
+        return ra, rb, b_wall
+
+    try:
+        ra, rb, b_wall = asyncio.run(scenario())
+        assert ra["completion_tokens"] == 2 and rb["completion_tokens"] == 2
+        m = engine.metrics()
+        # B's admission (submit -> first chunk) must be far below A's
+        # remaining prefill time; the last admission sample is B's
+        assert m["admission_samples"][-1] < 1000, m["admission_samples"]
+        assert b_wall < 30  # sanity: B wasn't serialized behind all of A
+    finally:
+        engine.shutdown()

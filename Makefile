@@ -37,6 +37,8 @@ dryrun:
 verify:
 	@$(PY) -c "import jax; print('jax', jax.__version__, jax.default_backend(), jax.devices())"
 	@$(PY) -c "from agentainer_tpu.native import available; print('native store:', 'ok' if available() else 'MISSING')"
+	@timeout 120 $(PY) -c "import jax.numpy as jnp; print('device exec:', float(jnp.add(1, 1)))" \
+	  || echo "device exec: UNREACHABLE (listing can succeed while the compile service is wedged)"
 
 clean:
 	$(MAKE) -C native clean 2>/dev/null || true

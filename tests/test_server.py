@@ -550,3 +550,45 @@ def test_server_logs_follow_streams_live_entries(tmp_path):
         await client.close()
 
     run(body())
+
+
+def test_engines_ready_kicks_replay(tmp_path):
+    """The model-loaded callback authenticates with the per-engine token and
+    kicks an immediate replay scan (event-driven drain, VERDICT r4 #4)."""
+
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        services.store.set("internal:token:agent-x", "tok-x")
+        await services.replay.start()
+        try:
+            kicked = asyncio.Event()
+            orig = services.replay.scan_once
+
+            async def spy():
+                kicked.set()
+                return await orig()
+
+            services.replay.scan_once = spy
+
+            # wrong token → 401, no kick
+            resp = await client.post(
+                "/internal/engines/ready",
+                headers={"Authorization": "Bearer nope", "X-Agentainer-Agent-ID": "agent-x"},
+            )
+            assert resp.status == 401
+
+            resp = await client.post(
+                "/internal/engines/ready",
+                headers={"Authorization": "Bearer tok-x", "X-Agentainer-Agent-ID": "agent-x"},
+            )
+            assert resp.status == 200
+            doc = await resp.json()
+            assert doc["data"]["kicked"] is True
+            # the kick wakes the worker loop well before the 5s cadence
+            await asyncio.wait_for(kicked.wait(), timeout=2.0)
+        finally:
+            await services.replay.stop()
+            await client.close()
+
+    run(body())

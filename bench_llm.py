@@ -143,8 +143,8 @@ def _tpu_preflight(timeout_s: float) -> str | None:
             # not prove the tunnel is gone — a false negative costs the
             # whole LLM bench, so retry before giving up
             err = (
-                f"TPU runtime unreachable: jax.devices() hung for "
-                f"{timeout_s:.0f}s x{attempt + 1} (tunnel wedged?)"
+                f"TPU runtime unreachable: executed device fetch hung for "
+                f"{timeout_s:.0f}s x{attempt + 1} (tunnel/compile service wedged?)"
             )
             log(f"preflight attempt {attempt + 1}/{tries} hung; retrying")
             continue

@@ -278,8 +278,33 @@ class LLMServeApp:
             )
             conn.getresponse().read()
             conn.close()
-        except OSError:
-            pass
+        except Exception as e:
+            # best effort, but NEVER fatal: http.client raises more than
+            # OSError (BadStatusLine/HTTPException on a garbled response),
+            # and this runs on the model-loader thread — an escape here
+            # used to kill the loader before the tenant ready fan-out
+            # (ADVICE r5); the 5s replay cadence remains the safety net
+            print(
+                f"[llm-serve] ready callback failed for {self.agent_id}: "
+                f"{type(e).__name__}: {e}",
+                flush=True,
+            )
+
+    def _fan_out_ready(self) -> None:
+        """Model-loaded notification for this app AND every attached tenant.
+        Per-tenant isolation: one tenant's failing callback must not skip
+        the rest (their control planes would all fall back to the replay
+        scan cadence)."""
+        self._notify_ready()
+        for tenant, _, _ in list(self._tenants.values()):
+            try:
+                tenant._notify_ready()
+            except Exception as e:
+                print(
+                    f"[llm-serve] tenant {tenant.agent_id} ready fan-out "
+                    f"failed: {type(e).__name__}: {e}",
+                    flush=True,
+                )
 
     def app(self) -> web.Application:
         @web.middleware
@@ -351,9 +376,7 @@ class LLMServeApp:
                     # set even on loader death: waiters unblock
                     loop.call_soon_threadsafe(self._ready.set)
                     if self.engine is not None:
-                        self._notify_ready()
-                        for tenant, _, _ in list(self._tenants.values()):
-                            tenant._notify_ready()
+                        self._fan_out_ready()
 
             threading.Thread(target=_run, daemon=True, name="model-loader").start()
 
@@ -559,6 +582,7 @@ class LLMServeApp:
                     "completion_tokens": result["completion_tokens"],
                 },
                 "ttft_ms": result.get("ttft_ms"),
+                "ttft_breakdown": result.get("ttft_breakdown"),
             }
         )
 

@@ -114,6 +114,10 @@ class MetricsPlane:
                 # floats each) belong to the live engine endpoint — persisted
                 # into every 10s history entry they'd bloat the store by
                 # ~15KB/sample (~130MB/day/agent) for no query value
+                # (the engine dict carries the TTFT phase decomposition —
+                # admission/queue-wait, ttft_prefill_ms_p50,
+                # ttft_first_readback_ms_p50 — and the adaptive decode-chunk
+                # histogram; only the raw sample arrays are dropped)
                 sample["engine"] = {
                     k: v for k, v in engine_stats.items() if not k.endswith("_samples")
                 }
@@ -122,6 +126,18 @@ class MetricsPlane:
             if hasattr(self.manager.backend, "host_stats"):
                 host = self.manager.backend.host_stats(agent.engine_id)
                 if host:
+                    n = host.get("host_tenants")
+                    if n and n > 1:
+                        # multi-tenant host: the raw numbers are the WHOLE
+                        # shared process, repeated in every tenant's sample —
+                        # attribute an even share so summing over agents
+                        # yields the process once, not N× (ADVICE r5)
+                        if host.get("host_cpu_pct") is not None:
+                            host["host_cpu_pct_share"] = round(
+                                host["host_cpu_pct"] / n, 2
+                            )
+                        if host.get("host_rss_bytes") is not None:
+                            host["host_rss_bytes_share"] = host["host_rss_bytes"] // n
                     sample["host"] = host
         placement = self.manager.scheduler.placement(agent_id)
         if placement:

@@ -114,9 +114,10 @@ def _moe_mlp(x: jnp.ndarray, lp: dict, cfg: ModelConfig) -> jnp.ndarray:
     return jnp.einsum("bted,bte->btd", expert_out, combine)
 
 
-# token counts at or below this run routed MoE with cap = n (dropless):
-# covers every decode call (n = max_batch lanes) without inflating prefill
-# dispatch buffers
+# token counts at or below this run routed MoE with cap = n (dropless) even
+# for prefill-shaped (t > 1) calls, where dropless is free anyway. Decode
+# calls (t == 1) are ALWAYS dropless via the shape gate in _moe_mlp_routed,
+# whatever max_batch is.
 _DROPLESS_MAX_N = 64
 
 
@@ -163,10 +164,14 @@ def _moe_mlp_routed(
     # pipelined decode feeds every lane — including parked/idle ones —
     # through this path, and cumsum slot assignment would let a parked
     # lane's garbage token steal a real token's expert capacity (ADVICE
-    # r4). cap = n makes stealing impossible and costs almost nothing at
-    # decode batch sizes; prefill (n = bucket, all real tokens from ONE
-    # sequence) keeps the cf-bounded buffers.
-    if n <= _DROPLESS_MAX_N:
+    # r4). Gate on the CALL SHAPE, not a fixed token count: the old
+    # n <= _DROPLESS_MAX_N gate silently reverted engines configured with
+    # max_batch > 64 to cf-capped routing — exactly the stealing bug again
+    # (ADVICE r5). cap = n makes stealing impossible and costs almost
+    # nothing at decode batch sizes; prefill (t = bucket, all real tokens
+    # from ONE sequence) keeps the cf-bounded buffers unless it is small
+    # enough that dropless is free anyway.
+    if t == 1 or n <= _DROPLESS_MAX_N:
         cap = n
     else:
         cap = routed_capacity(n, cfg.n_experts, k, capacity_factor)

@@ -623,9 +623,19 @@ class LocalBackend(Backend):
             if rec is None:
                 return None
             proc = rec.proc
+            shared_tenants = 0
             if rec.share_key is not None:
                 host = self._hosts.get(rec.share_key)
                 proc = host.proc if host else None
+                # the CPU%/RSS below belong to the SHARED host process: every
+                # attached tenant's sample carries the same numbers, so fleet
+                # aggregation must divide by the tenant count instead of
+                # multiplying the process by N (ADVICE r5)
+                shared_tenants = sum(
+                    1
+                    for r in self._recs.values()
+                    if r.share_key == rec.share_key and r.attached
+                )
             if proc is None or proc.poll() is not None:
                 return None
             pid = proc.pid
@@ -649,11 +659,15 @@ class LocalBackend(Backend):
             if dt > 0:
                 cpu_pct = round(100.0 * (jiffies - prev[1]) / hz / dt, 1)
         self._cpu_last[engine_id] = (now, jiffies, pid)
-        return {
+        doc = {
             "pid": pid,
             "host_cpu_pct": cpu_pct,
             "host_rss_bytes": rss_pages * page,
         }
+        if shared_tenants:
+            doc["shared"] = True
+            doc["host_tenants"] = shared_tenants
+        return doc
 
     def probe_engine(self, engine_id: str) -> bool:
         """Real liveness: the engine answers /health. Process state alone

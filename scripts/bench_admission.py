@@ -1,0 +1,205 @@
+"""Mid-decode-arrival admission benchmark: adaptive vs fixed scheduling.
+
+A/B for the admission-aware scheduler (engine/llm.py): the SAME engine
+config is driven twice, once with ``adaptive_decode`` off (the round-5
+fixed-cadence worker: full decode chunks, hard-blocking readback drains —
+a new arrival waits out the in-flight chunk wall before its first prefill
+chunk dispatches) and once with it on (chunk ladder + interruptible
+drains + multi-tick prefill). Each pass measures:
+
+  admission_ms_p50/p90 — queue-wait phase of probes submitted while two
+                         background generations keep the decode loop busy
+  itl_ms_p50_steady    — inter-token latency of an UNCONTENDED long
+                         generation (the <5% regression guard: adaptive
+                         chunking must not tax steady state)
+
+Runs on whatever JAX platform is available — the scheduler artifact being
+measured is host-side worker-loop behavior, so a CPU run is a faithful
+A/B even though absolute numbers are smaller than on a tunneled TPU. The
+default decode_chunk here is 16 (vs the serving default 8): the A/B is
+meaningful when the chunk wall dominates the worker loop's few-ms
+overhead, which is the TPU regime (8 × 22 ms ITL ≈ 180 ms wall) — on CPU
+the tiny model's chunk-8 wall (~8 ms) sits inside loop-overhead noise.
+
+Usage: JAX_PLATFORMS=cpu python scripts/bench_admission.py
+Emits one JSON line on stdout; the repo's committed artifact is
+BENCH_admission.json.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MODEL = os.environ.get("ATPU_ADM_MODEL", "tiny")
+PROBES = int(os.environ.get("ATPU_ADM_PROBES", "32"))
+DECODE_CHUNK = int(os.environ.get("ATPU_ADM_DECODE_CHUNK", "16"))
+MAX_BATCH = int(os.environ.get("ATPU_ADM_MAX_BATCH", "8"))
+# burst phase: agentic fan-out — W waves of K simultaneous arrivals while
+# decode is busy. Fixed cadence admits ONE first-chunk per full chunk wall
+# (probe k waits ~k walls); the adaptive engine admits the wave back to
+# back, so the contrast grows with K.
+BURST_WAVES = int(os.environ.get("ATPU_ADM_BURST_WAVES", "5"))
+BURST_K = int(os.environ.get("ATPU_ADM_BURST_K", "6"))
+# multi-chunk probe prompt: keeps pending_prompt non-empty for several
+# ticks, so the contention-shrink path is exercised, not just the
+# interruptible drain
+PROBE_PROMPT = "where does the admission latency go? " * 8
+
+
+def _p(sorted_xs: list, q: float):
+    if not sorted_xs:  # ATPU_ADM_PROBES=0 / _BURST_WAVES=0 must not crash
+        return None
+    return round(sorted_xs[min(len(sorted_xs) - 1, int(q * len(sorted_xs)))], 3)
+
+
+async def _measure(adaptive: bool) -> dict:
+    from agentainer_tpu.engine.llm import LLMEngine
+
+    eng = LLMEngine.create(
+        MODEL,
+        options={
+            "max_batch": MAX_BATCH,
+            "max_seq": 512,
+            "decode_chunk": DECODE_CHUNK,
+            "prefill_chunk": 32,
+            "adaptive_decode": adaptive,
+        },
+    )
+    try:
+        # steady state: long generations with nobody waiting — the ITL
+        # guard (adaptive must dispatch full chunks here). Wall-clock per
+        # generated token, best of two passes: a p50 over a handful of
+        # chunk samples is too noisy for a <5% regression check on a
+        # shared host.
+        steady: list[float] = []
+        for _ in range(3):
+            ts = time.monotonic()
+            r = await eng.generate("steady state pass", max_tokens=300, temperature=0.0)
+            steady.append(
+                1000 * (time.monotonic() - ts) / max(1, r["completion_tokens"])
+            )
+        itl_steady = round(min(steady), 3)
+        hist_steady = dict(eng.metrics()["decode_chunk_hist"])
+
+        # mid-decode arrivals: two lanes keep decoding throughout; probes
+        # submit while their chunks are in flight
+        stop = False
+
+        async def bg(i: int) -> None:
+            while not stop:
+                # long generations: restart gaps (idle worker → fast
+                # admission in BOTH modes) would dilute the contrast
+                await eng.generate(
+                    f"background load lane {i}", max_tokens=400, temperature=0.0
+                )
+
+        tasks = [asyncio.ensure_future(bg(i)) for i in range(2)]
+        await asyncio.sleep(0.3)  # decode well under way
+        adm: list[float] = []
+        ttfts: list[float] = []
+        for k in range(PROBES):
+            r = await eng.generate(
+                f"{PROBE_PROMPT}#{k}", max_tokens=2, temperature=0.0
+            )
+            bd = r.get("ttft_breakdown") or {}
+            if bd.get("queue_ms") is not None:
+                adm.append(bd["queue_ms"])
+                ttfts.append(r["ttft_ms"])
+            await asyncio.sleep(0.01)
+        # burst arrivals: K at once, single-chunk prompts (admission is the
+        # first-chunk dispatch — short prompts keep the phases clean)
+        burst_adm: list[float] = []
+        for w in range(BURST_WAVES):
+            rs = await asyncio.gather(
+                *(
+                    eng.generate(
+                        f"burst wave {w} member {j}", max_tokens=2, temperature=0.0
+                    )
+                    for j in range(BURST_K)
+                )
+            )
+            for r in rs:
+                bd = r.get("ttft_breakdown") or {}
+                if bd.get("queue_ms") is not None:
+                    burst_adm.append(bd["queue_ms"])
+            await asyncio.sleep(0.05)
+        stop = True
+        await asyncio.gather(*tasks)
+        m = eng.metrics()
+        adm.sort()
+        ttfts.sort()
+        burst_adm.sort()
+        return {
+            "adaptive_decode": adaptive,
+            "decode_chunk": DECODE_CHUNK,
+            "probes": len(adm),
+            "admission_ms_p50": _p(adm, 0.5),
+            "admission_ms_p90": _p(adm, 0.9),
+            "ttft_ms_p50": _p(ttfts, 0.5),
+            "burst_admission_ms_p50": _p(burst_adm, 0.5),
+            "burst_admission_ms_p90": _p(burst_adm, 0.9),
+            "burst_size": BURST_K,
+            "itl_ms_p50_steady": itl_steady,
+            "decode_chunk_hist_steady": hist_steady,
+            "decode_chunk_hist": m["decode_chunk_hist"],
+            "decode_chunks_shrunk": m["decode_chunks_shrunk"],
+            "worker_errors": m["worker_errors"],
+        }
+    finally:
+        eng.shutdown()
+
+
+async def run() -> dict:
+    t0 = time.monotonic()
+    fixed = await _measure(adaptive=False)
+    adaptive = await _measure(adaptive=True)
+    # headline: burst-arrival admission (the agentic fan-out pattern the
+    # scheduler change targets); solo-probe admission is recorded alongside
+    ratio = None
+    if fixed["burst_admission_ms_p50"]:
+        ratio = round(
+            adaptive["burst_admission_ms_p50"] / fixed["burst_admission_ms_p50"], 3
+        )
+    solo_ratio = None
+    if fixed["admission_ms_p50"]:
+        solo_ratio = round(adaptive["admission_ms_p50"] / fixed["admission_ms_p50"], 3)
+    itl_reg = None
+    if fixed["itl_ms_p50_steady"]:
+        itl_reg = round(
+            adaptive["itl_ms_p50_steady"] / fixed["itl_ms_p50_steady"] - 1.0, 4
+        )
+    import jax
+
+    return {
+        "metric": "llm_admission_ms_p50_adaptive_over_fixed",
+        "value": ratio,
+        "unit": "ratio",
+        "solo_ratio": solo_ratio,
+        "platform": jax.default_backend(),
+        "model": MODEL,
+        "fixed": fixed,
+        "adaptive": adaptive,
+        "itl_steady_regression": itl_reg,
+        "wall_s": round(time.monotonic() - t0, 1),
+    }
+
+
+def main() -> None:
+    out = asyncio.run(run())
+    print(json.dumps(out), flush=True)
+    # acceptance guard (ISSUE 1): adaptive admission ≤ 0.5× fixed, steady
+    # ITL regression < 5% — exit non-zero so a driver sees the miss
+    ok = (out["value"] is not None and out["value"] <= 0.5) and (
+        out["itl_steady_regression"] is None or out["itl_steady_regression"] < 0.05
+    )
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -41,6 +41,25 @@ def test_routed_matches_dense_when_dropless():
     np.testing.assert_allclose(np.asarray(routed), np.asarray(dense), atol=1e-5)
 
 
+def test_decode_shape_dropless_any_batch():
+    """ADVICE r5: dropless decode is gated on the CALL SHAPE (t == 1), not
+    a fixed token count — an engine with max_batch > 64 must still route
+    decode dropless, or parked-lane garbage steals real tokens' expert
+    capacity. With cap = n the routed output equals dense even under a
+    starvation-level capacity factor and 128 lanes."""
+    cfg = get_config("tiny-moe")
+    lp = _layer0(cfg)
+    # decode shape: [B=128, T=1, D] — n = 128 > the old _DROPLESS_MAX_N=64
+    x = jax.random.normal(jax.random.PRNGKey(7), (128, 1, cfg.dim), jnp.float32)
+    dense = _moe_mlp(x, lp, cfg)
+    routed = _moe_mlp_routed(x, lp, cfg, capacity_factor=0.05)  # cf-cap would drop hard
+    np.testing.assert_allclose(np.asarray(routed), np.asarray(dense), atol=1e-5)
+    # prefill shape of the same token count still honors the cf cap
+    xp = x.reshape(1, 128, cfg.dim)
+    routed_p = _moe_mlp_routed(xp, lp, cfg, capacity_factor=0.05)
+    assert not np.allclose(np.asarray(routed_p), np.asarray(dense.reshape(1, 128, -1)))
+
+
 def test_routed_drops_overflow_tokens_without_crashing():
     cfg = get_config("tiny-moe")
     lp = _layer0(cfg)

@@ -1,0 +1,161 @@
+"""ADVICE r5 hardening satellites: the model-loader ready callback must
+survive any HTTP client exception and fan out to every tenant; host-process
+stats must carry the shared-tenant count so fleet aggregation doesn't
+multiply one process by N agents; the metrics plane attributes an even
+per-tenant share.
+"""
+
+import http.client
+import os
+from types import SimpleNamespace
+
+from agentainer_tpu.engine.llm_serve import LLMServeApp
+from agentainer_tpu.manager.metrics import MetricsPlane
+from agentainer_tpu.runtime.local import LocalBackend, _EngineRec, _HostRec
+
+_ENV = {
+    "AGENTAINER_AGENT_ID": "t-hardening",
+    "AGENTAINER_CONTROL_URL": "http://127.0.0.1:1",
+    "AGENTAINER_INTERNAL_TOKEN": "tok",
+}
+
+
+class _BoomConnection:
+    def __init__(self, *a, **k):
+        pass
+
+    def request(self, *a, **k):
+        pass
+
+    def getresponse(self):
+        raise http.client.BadStatusLine("garbled")  # NOT an OSError
+
+    def close(self):
+        pass
+
+
+def test_notify_ready_survives_non_oserror(monkeypatch):
+    """BadStatusLine/HTTPException from http.client used to escape the
+    OSError-only except and kill the model-loader thread before the tenant
+    fan-out (ADVICE r5)."""
+    app = LLMServeApp(env=dict(_ENV))
+    monkeypatch.setattr(http.client, "HTTPConnection", _BoomConnection)
+    app._notify_ready()  # must not raise
+
+
+class _Tenant:
+    def __init__(self, fail: bool):
+        self.agent_id = "tenant-fail" if fail else "tenant-ok"
+        self.fail = fail
+        self.called = False
+
+    def _notify_ready(self):
+        self.called = True
+        if self.fail:
+            raise RuntimeError("tenant callback boom")
+
+
+def test_fan_out_ready_isolates_tenant_failures():
+    """One tenant's failing ready callback must not skip the rest."""
+    host = LLMServeApp(env={"AGENTAINER_AGENT_ID": "host"})  # no control URL:
+    # host's own _notify_ready is a no-op, the fan-out is what's under test
+    bad, good = _Tenant(fail=True), _Tenant(fail=False)
+    host._tenants = {"bad": (bad, None, 0), "good": (good, None, 0)}
+    host._fan_out_ready()  # must not raise
+    assert bad.called and good.called
+
+
+class _FakeProc:
+    def __init__(self, pid: int):
+        self.pid = pid
+
+    def poll(self):
+        return None  # alive
+
+
+def _rec(engine_id: str, tmp_path, **kw) -> _EngineRec:
+    base = dict(
+        engine_id=engine_id,
+        agent_id=f"a-{engine_id}",
+        port=1,
+        cmd=[],
+        env={},
+        chips=(),
+        auto_restart=False,
+        log_path=tmp_path / f"{engine_id}.log",
+    )
+    base.update(kw)
+    return _EngineRec(**base)
+
+
+def test_host_stats_reports_shared_tenant_count(tmp_path):
+    """Multi-tenant host: every attached tenant's sample carries the WHOLE
+    process CPU/RSS — the block must say so (shared + host_tenants) so an
+    aggregator can divide instead of multiplying by N (ADVICE r5)."""
+    backend = LocalBackend(data_dir=tmp_path)
+    key = ("tiny", "", "0")
+    backend._recs = {
+        "e1": _rec("e1", tmp_path, share_key=key, attached=True),
+        "e2": _rec("e2", tmp_path, share_key=key, attached=True),
+    }
+    backend._hosts = {
+        key: _HostRec(
+            key=key,
+            port=2,
+            admin_token="t",
+            env={},
+            log_path=tmp_path / "host.log",
+            proc=_FakeProc(os.getpid()),  # real /proc entry to read
+        )
+    }
+    s = backend.host_stats("e1")
+    assert s is not None
+    assert s["shared"] is True
+    assert s["host_tenants"] == 2
+    assert s["host_rss_bytes"] > 0
+
+
+def test_host_stats_single_process_unchanged(tmp_path):
+    """Non-shared engines keep the plain block — no spurious shared flag."""
+    backend = LocalBackend(data_dir=tmp_path)
+    backend._recs = {"e1": _rec("e1", tmp_path, proc=_FakeProc(os.getpid()))}
+    s = backend.host_stats("e1")
+    assert s is not None
+    assert "shared" not in s and "host_tenants" not in s
+
+
+class _NoopStore:
+    def set_json(self, *a, **k):
+        pass
+
+    def zadd(self, *a, **k):
+        pass
+
+    def zremrangebyscore(self, *a, **k):
+        pass
+
+
+def test_metrics_plane_attributes_even_share():
+    """The collector derives per-agent CPU/RSS shares from the host block's
+    tenant count, so summing over agents yields the process once."""
+    host_block = {
+        "pid": 1,
+        "host_cpu_pct": 50.0,
+        "host_rss_bytes": 1000,
+        "shared": True,
+        "host_tenants": 2,
+    }
+    manager = SimpleNamespace(
+        try_get=lambda a: SimpleNamespace(id=a, engine_id="e1"),
+        backend=SimpleNamespace(
+            stats=lambda e: {"tokens_generated": 1},
+            host_stats=lambda e: dict(host_block),
+        ),
+        scheduler=SimpleNamespace(placement=lambda a: None),
+    )
+    plane = MetricsPlane(manager, _NoopStore())
+    sample = plane.sample_agent("a1")
+    assert sample["host"]["host_cpu_pct_share"] == 25.0
+    assert sample["host"]["host_rss_bytes_share"] == 500
+    # raw process numbers stay (they are the truth about the process)
+    assert sample["host"]["host_cpu_pct"] == 50.0

@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all native test test-native test-kernels bench server dryrun verify clean
+.PHONY: all native test t1 test-native test-kernels bench server dryrun verify clean
 
 all: native
 
@@ -14,6 +14,11 @@ native:
 
 test: native
 	$(PY) -m pytest tests/ -q
+
+# tier-1 verify: the EXACT command from ROADMAP.md (the driver's gate) —
+# CPU platform, non-slow suite, DOTS_PASSED echoed for the pass floor
+t1:
+	bash -c 'set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m "not slow" --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE "^[.FEsx]+( *\[ *[0-9]+%\])?$$" /tmp/_t1.log | tr -cd . | wc -c); exit $$rc'
 
 test-native: native
 	$(PY) -m pytest tests/test_native.py tests/test_dataplane.py tests/test_store.py -q

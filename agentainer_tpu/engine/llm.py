@@ -122,10 +122,32 @@ class SnapshotCmd:
 
 
 @dataclass
+class PrefixEntry:
+    """One cached token-prefix in the prefix arena: the KV a prefill wrote
+    for ``tokens`` (exact bucket length), held in fresh device buffers that
+    outlive any later donation of the main cache. ``tokens`` is kept so a
+    lookup verifies exact token equality — a rolling-hash collision must
+    degrade to a miss, never serve another prompt's context."""
+
+    k: Any  # [L, bucket, KV, hd], compute dtype (exact — no fp16 round-trip)
+    v: Any
+    tokens: tuple
+    nbytes: int
+    created: float
+    last_used: float
+    hits: int = 0
+
+
+@dataclass
 class Slot:
     idx: int
     session: str = ""
     position: int = 0  # next cache position to write
+    # fresh-context prompts (prefill starting from position 0) are tracked
+    # here so the final prefill chunk can register their bucket-prefixes in
+    # the prefix arena; continuing sessions carry None (their context since
+    # position 0 is not reconstructible from the request alone)
+    prefix_ctx: list[int] | None = None
     request: GenRequest | None = None
     # prompt tokens not yet prefilled: chunked prefill feeds these through
     # the model a chunk at a time, interleaved with decode steps, so one
@@ -167,6 +189,8 @@ class LLMEngine:
         routed_moe: bool | None = None,
         moe_capacity_factor: float = 2.0,
         adaptive_decode: bool = True,
+        prefix_cache: bool = True,
+        prefix_cache_bytes: int = 0,
     ):
         self.cfg = cfg
         self.tokenizer = tokenizer
@@ -410,6 +434,44 @@ class LLMEngine:
             x.nbytes for x in jax.tree.leaves(params)
         )
         self.kv_arena_bytes = cache.k.nbytes + cache.v.nbytes
+        # Cross-session prefix arena: bucket-length token prefixes → their
+        # prefilled KV, populated the first time a prefix is prefilled and
+        # forked into a fresh slot on admission (the second session with a
+        # shared system prompt prefills only its uncached tail). Keyed by a
+        # rolling hash of the token ids at bucket granularity, verified by
+        # exact token equality, LRU-evicted under the bytes budget.
+        # prefix_cache=False is the A/B baseline (mirrors adaptive_decode).
+        self.prefix_cache = bool(prefix_cache)
+        self._prefix_active = self.prefix_cache  # warmup serves with it off
+        # bucket levels a prefix can be cached at: a hit must leave ≥1
+        # prompt token to prefill (the first token is sampled from prefill
+        # logits), so levels cap below the longest admissible prompt
+        self._prefix_levels = [b for b in PREFILL_BUCKETS if b <= max_seq - 2]
+        self._prefix_entries: collections.OrderedDict[tuple, PrefixEntry] = (
+            collections.OrderedDict()
+        )
+        self._prefix_bytes = 0
+        # arena budget defaults to the main KV arena's size: one extra
+        # arena's worth of HBM buys ~every repeat prefill in the workload
+        self._prefix_budget = (
+            int(prefix_cache_bytes) if prefix_cache_bytes else self.kv_arena_bytes
+        )
+        self._prefix_slice_fns: dict[int, Any] = {}
+        self._prefix_fork_fns: dict[int, Any] = {}
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_tokens_saved = 0
+        # eviction observability (session KV eviction used to be silent):
+        # both the slot LRU and the prefix arena count through the same
+        # path, so hit-rate regressions trace to churn in either pool
+        self.session_evictions = 0
+        self.prefix_evictions = 0
+        self.session_eviction_idle_s_recent: collections.deque[float] = (
+            collections.deque(maxlen=64)
+        )
+        self.prefix_eviction_idle_s_recent: collections.deque[float] = (
+            collections.deque(maxlen=64)
+        )
         self._n_chips = self.tp * self.ep * self.sp * self.pp
         self._chip = chip_spec((devices or jax.devices() or [None])[0])
         self._peak_flops = self._chip.bf16_flops * self._n_chips
@@ -526,6 +588,8 @@ class LLMEngine:
                 devices=devices,
                 mesh=mesh,
                 adaptive_decode=bool(options.get("adaptive_decode", True)),
+                prefix_cache=bool(options.get("prefix_cache", True)),
+                prefix_cache_bytes=int(options.get("prefix_cache_bytes", 0) or 0),
             )
             if not options.get("skip_warmup"):
                 engine.warmup()
@@ -645,6 +709,8 @@ class LLMEngine:
             routed_moe=options.get("routed"),
             moe_capacity_factor=float(options.get("moe_cf", 2.0)),
             adaptive_decode=bool(options.get("adaptive_decode", True)),
+            prefix_cache=bool(options.get("prefix_cache", True)),
+            prefix_cache_bytes=int(options.get("prefix_cache_bytes", 0) or 0),
         )
         # pay the decode/prefill compiles here (inside the loader thread, while
         # /health keeps answering) instead of on the first user request.
@@ -825,9 +891,17 @@ class LLMEngine:
             except BaseException as e:  # surface warmup faults to create()
                 box.append(e)
 
-        t = threading.Thread(target=_runner, name="llm-warmup")
-        t.start()
-        t.join()
+        # the arena stays OFF while warmup serves: the bucket passes share a
+        # filler-token prefix, and a prefix hit would shrink a pass's tail
+        # below its bucket — exactly the prefill signature warmup exists to
+        # compile. The fork/slice fns are warmed explicitly below instead.
+        self._prefix_active = False
+        try:
+            t = threading.Thread(target=_runner, name="llm-warmup")
+            t.start()
+            t.join()
+        finally:
+            self._prefix_active = self.prefix_cache
         if box:
             raise box[0]
         # pre-compile the snapshot slicers too: their first jit used to
@@ -843,6 +917,18 @@ class LLMEngine:
             b *= 2
         for bucket in sorted(snap_buckets):
             jax.block_until_ready(self._snap_fn(bucket)(self.cache, jnp.int32(0)))
+        # prefix-arena copy fns (same warm-up pattern as the snapshot
+        # slicers): one slice + one fork executable per bucket level, so an
+        # admission-time fork never pays a serve-time compile. The fork
+        # round-trips slot 0's own rows — it writes back exactly what it
+        # read, so warmed state is untouched.
+        if self.prefix_cache:
+            for b in self._prefix_levels:
+                k, v = self._prefix_slice_fn(b)(self.cache, jnp.int32(0))
+                self.cache = self._prefix_fork_fn(b)(
+                    self.cache, jnp.int32(0), k, v
+                )
+            jax.block_until_ready(self.cache.k)
         # warmup traffic is not serving telemetry: TTFT samples here include
         # compile time and would pollute p50s until the deque rolls over
         self.clear_sessions()
@@ -853,6 +939,15 @@ class LLMEngine:
         self.first_readback_ms_recent.clear()
         self.decode_chunk_hist = {}
         self.decode_chunks_shrunk = 0
+        self._prefix_entries.clear()
+        self._prefix_bytes = 0
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_tokens_saved = 0
+        self.prefix_evictions = 0
+        self.session_evictions = 0
+        self.session_eviction_idle_s_recent.clear()
+        self.prefix_eviction_idle_s_recent.clear()
         self.tokens_generated = 0
         self.prefills = 0
         self.decode_steps = 0
@@ -1042,6 +1137,130 @@ class LLMEngine:
             fn = self._snap_fns[bucket] = jax.jit(_snap)
         return fn
 
+    # -- prefix arena (cross-session KV reuse; worker thread) -------------
+    @staticmethod
+    def _rolling_hashes(tokens: list[int]) -> dict[int, int]:
+        """FNV-1a rolling hash of the token-id stream, sampled at every
+        prefill-bucket boundary: hashes[b] keys the exact prefix tokens[:b].
+        One O(len) pass per admission/registration — the same order of work
+        as tokenizing the prompt."""
+        h = 1469598103934665603
+        out: dict[int, int] = {}
+        bi = 0
+        for i, t in enumerate(tokens):
+            h = ((h ^ (int(t) + 1)) * 1099511628211) & 0xFFFFFFFFFFFFFFFF
+            if bi < len(PREFILL_BUCKETS) and i + 1 == PREFILL_BUCKETS[bi]:
+                out[PREFILL_BUCKETS[bi]] = h
+                bi += 1
+        return out
+
+    def _prefix_slice_fn(self, bucket: int):
+        """Copy a slot's first ``bucket`` KV positions into FRESH device
+        buffers (one compiled program per bucket, like _snap_fn). The
+        outputs are independent arrays, so they survive every later
+        donation of the main cache. No dtype cast: a forked prefix must be
+        bit-exact with the prefill that produced it."""
+        fn = self._prefix_slice_fns.get(bucket)
+        if fn is None:
+
+            def _slice(cache, i, _b=bucket):
+                k = lax.dynamic_slice_in_dim(cache.k, i, 1, axis=1)[:, 0, :_b]
+                v = lax.dynamic_slice_in_dim(cache.v, i, 1, axis=1)[:, 0, :_b]
+                return k, v
+
+            fn = self._prefix_slice_fns[bucket] = jax.jit(_slice)
+        return fn
+
+    def _prefix_fork_fn(self, bucket: int):
+        """Write an arena entry into a slot's rows at position 0 (the
+        admission-time fork). Donates the cache — in-place on device; the
+        entry buffers are NOT donated, so the arena can fork the same
+        prefix into any number of later sessions."""
+        fn = self._prefix_fork_fns.get(bucket)
+        if fn is None:
+
+            def _fork(cache, i, k, v):
+                newk = lax.dynamic_update_slice(cache.k, k[:, None], (0, i, 0, 0, 0))
+                newv = lax.dynamic_update_slice(cache.v, v[:, None], (0, i, 0, 0, 0))
+                return KVCache(newk, newv)
+
+            fn = self._prefix_fork_fns[bucket] = jax.jit(_fork, donate_argnums=(0,))
+        return fn
+
+    def _prefix_lookup(self, prompt: list[int]):
+        """Longest cached prefix at bucket granularity, or None. A hit must
+        leave at least one prompt token to prefill (the first generated
+        token is sampled from prefill logits). Hash match is verified by
+        exact token equality — a collision degrades to a miss."""
+        limit = len(prompt) - 1
+        hashes = self._rolling_hashes(prompt)
+        for b in reversed(self._prefix_levels):
+            if b > limit:
+                continue
+            key = (b, hashes.get(b))
+            entry = self._prefix_entries.get(key)
+            if entry is not None and entry.tokens == tuple(prompt[:b]):
+                return key, entry
+        return None
+
+    def _prefix_register(self, slot: Slot) -> None:
+        """Final-prefill-chunk hook: store every bucket-level prefix of a
+        fresh-context prompt that isn't cached yet. Each level is one
+        async device copy; positions [0:b] hold real KV for exactly
+        ctx[:b] by causality (later tokens cannot influence them).
+        Best-effort — a failure here must never fail the generation."""
+        ctx = slot.prefix_ctx
+        slot.prefix_ctx = None
+        if ctx is None or not self._prefix_active:
+            return
+        n = min(len(ctx), slot.position)
+        try:
+            hashes = self._rolling_hashes(ctx)
+            now = time.monotonic()
+            for b in self._prefix_levels:
+                if b > n:
+                    break
+                key = (b, hashes[b])
+                if key in self._prefix_entries:
+                    continue
+                k, v = self._prefix_slice_fn(b)(self.cache, jnp.int32(slot.idx))
+                nbytes = int(k.nbytes + v.nbytes)
+                if nbytes > self._prefix_budget:
+                    break  # larger levels only grow — stop here
+                while (
+                    self._prefix_bytes + nbytes > self._prefix_budget
+                    and self._prefix_entries
+                ):
+                    self._prefix_evict_lru(now)
+                self._prefix_entries[key] = PrefixEntry(
+                    k=k,
+                    v=v,
+                    tokens=tuple(ctx[:b]),
+                    nbytes=nbytes,
+                    created=now,
+                    last_used=now,
+                )
+                self._prefix_bytes += nbytes
+        except Exception as e:
+            self._note_error(e)
+
+    def _prefix_evict_lru(self, now: float | None = None) -> None:
+        key, entry = self._prefix_entries.popitem(last=False)
+        self._prefix_bytes -= entry.nbytes
+        self._count_eviction(
+            "prefix", (now or time.monotonic()) - entry.last_used
+        )
+
+    def _count_eviction(self, kind: str, idle_s: float) -> None:
+        """Shared eviction counter path (session slots AND prefix arena):
+        a prefix hit-rate regression is diagnosed by which pool churns."""
+        if kind == "session":
+            self.session_evictions += 1
+            self.session_eviction_idle_s_recent.append(idle_s)
+        else:
+            self.prefix_evictions += 1
+            self.prefix_eviction_idle_s_recent.append(idle_s)
+
     async def restore_session(self, session: str, blob: bytes) -> bool:
         """Load a snapshot into a fresh slot (worker-thread mediated)."""
         from .checkpoint import deserialize_kv_slot
@@ -1116,6 +1335,29 @@ class LLMEngine:
             "worker_errors": self.worker_errors,
             "last_worker_error": self.last_worker_error or None,
             "cache_resets": self.cache_resets,
+            # prefix arena (cross-session KV reuse): hit/miss/saved counters
+            # plus occupancy — tokens_saved is prefill work the fork skipped
+            "prefix_cache": self.prefix_cache,
+            "prefix_hits": self.prefix_hits,
+            "prefix_misses": self.prefix_misses,
+            "prefix_tokens_saved": self.prefix_tokens_saved,
+            "prefix_arena_entries": len(self._prefix_entries),
+            "prefix_arena_bytes": self._prefix_bytes,
+            "prefix_arena_capacity_bytes": self._prefix_budget,
+            "prefix_evictions_total": self.prefix_evictions,
+            # session-slot LRU eviction (was silent): count + idle age of
+            # the evictees, so "why did my session re-prefill" is answerable
+            "session_evictions_total": self.session_evictions,
+            "session_eviction_idle_s_p50": (
+                round(sev[len(sev) // 2], 2)
+                if (sev := sorted(self.session_eviction_idle_s_recent))
+                else None
+            ),
+            "prefix_eviction_idle_s_p50": (
+                round(pev[len(pev) // 2], 2)
+                if (pev := sorted(self.prefix_eviction_idle_s_recent))
+                else None
+            ),
             # raw append-ordered samples (bounded deques): lets a caller
             # window percentiles over ITS measurement interval instead of
             # whatever warmup/compile history the deque still holds
@@ -1333,6 +1575,7 @@ class LLMEngine:
         slot.decoding = False
         slot.position = 0
         slot.pending_token = None
+        slot.prefix_ctx = None
         slot.epoch += 1
         if slot.session:
             # only drop the mapping if it still points HERE — clear_sessions
@@ -1420,10 +1663,48 @@ class LLMEngine:
             slot.epoch += 1
         if len(prompt) > budget:
             prompt = prompt[-budget:]  # keep the tail
+        # Fresh context (position 0): fork the longest cached prefix into
+        # this slot instead of re-prefilling it — a second session with a
+        # shared system prompt skips ~all of its prefill. Continuing
+        # sessions already hold their context in KV; nothing to fork.
+        forked = 0
+        if self._prefix_active and slot.position == 0:
+            if self._prefix_levels and len(prompt) > self._prefix_levels[0]:
+                hit = self._prefix_lookup(prompt)
+                if hit is not None:
+                    key, entry = hit
+                    b = key[0]
+                    try:
+                        self.cache = self._prefix_fork_fn(b)(
+                            self.cache, jnp.int32(slot.idx), entry.k, entry.v
+                        )
+                    except Exception:
+                        # the fork may have consumed its donated cache
+                        # without producing one — repair device state, then
+                        # let _admit_waiting fail this request
+                        self._ensure_device_state()
+                        raise
+                    forked = b
+                    slot.position = b
+                    entry.hits += 1
+                    entry.last_used = time.monotonic()
+                    self._prefix_entries.move_to_end(key)
+                    self.prefix_hits += 1
+                    self.prefix_tokens_saved += b
+                    # the fork streams the entry's KV once (copy, no FLOPs
+                    # — that's the point); keeps the MBU model honest
+                    self.hbm_bytes_read += b * self._kv_bytes_per_pos
+                else:
+                    self.prefix_misses += 1
+            # track the fresh context so the final prefill chunk registers
+            # its bucket-prefixes (including levels above a partial hit)
+            slot.prefix_ctx = list(prompt)
+        else:
+            slot.prefix_ctx = None
         # admit: the slot is busy from here; the worker's prefill tick feeds
         # the prompt through chunk-by-chunk, interleaved with decode steps
         slot.request = req
-        slot.pending_prompt = prompt
+        slot.pending_prompt = prompt[forked:]
         slot.last_used = time.monotonic()
         return True
 
@@ -1441,6 +1722,7 @@ class LLMEngine:
         slot = fresh[0] if fresh else min(idle, key=lambda s: s.last_used)
         if slot.session and self.sessions.get(slot.session) == slot.idx:
             self.sessions.pop(slot.session, None)  # evict LRU session's KV
+            self._count_eviction("session", time.monotonic() - slot.last_used)
             self._flush_parked_snapshot(slot.session)
         slot.session = session
         slot.position = 0
@@ -1505,6 +1787,10 @@ class LLMEngine:
         slot.last_used = time.monotonic()
         if not final:
             return
+        # whole fresh context now in KV: register its bucket-prefixes in
+        # the arena (async device copies; positions [0:b] are real tokens —
+        # the final chunk's padding lands strictly above slot.position)
+        self._prefix_register(slot)
         self._rng, key = jax.random.split(self._rng)
         first = sample(last_logits[None], key, temperature=jnp.asarray([req.temperature]))
         # point the slot's decode lane at this prompt's continuation WITHOUT

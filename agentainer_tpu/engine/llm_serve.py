@@ -25,6 +25,11 @@ from aiohttp import web
 from ..runtime.store_client import StoreClient
 
 MAX_TURNS = 50
+# per-session conversation lists carry a sliding TTL: session ids are
+# client-supplied, so without one every ephemeral session would leave a
+# permanent (ltrim-bounded) list behind — the old shared key was bounded
+# in TOTAL size, the per-session split must be bounded in key count too
+SESSION_CONVO_TTL_S = 7 * 24 * 3600
 LOADING_HEADER = "X-Agentainer-Loading"
 
 
@@ -90,6 +95,7 @@ class LLMServeApp:
         self._tenants: dict[str, tuple["LLMServeApp", web.AppRunner, int]] = {}
         self._host_token = E.get("AGENTAINER_HOST_TOKEN", "")
         self.kv_restores = 0
+        self.prefix_prewarms = 0
         self.kv_snapshots = 0
         self.kv_snapshots_deferred = 0
         self.kv_snapshot_errors = 0
@@ -140,7 +146,16 @@ class LLMServeApp:
 
     @property
     def convo_key(self) -> str:
+        """Legacy shared conversation list (every session interleaved).
+        Still read for backward compatibility; new turns land on the
+        per-session keys below."""
         return f"agent:{self.agent_id}:conversations"
+
+    def _convo_session_key(self, session: str) -> str:
+        """Per-session conversation list: the flattened-history prompt
+        builder reads O(history window) per turn instead of JSON-parsing
+        the whole shared list and filtering in Python."""
+        return f"{self.convo_key}:{session}"
 
     def _kv_key(self, session: str) -> str:
         return f"agent:{self.agent_id}:kvcache:{session}"
@@ -251,6 +266,31 @@ class LLMServeApp:
                         pass
         except BaseException as e:  # engine stays None; /chat reports 503
             self.engine_error = f"{type(e).__name__}: {e}"
+
+    async def _prewarm_prefix(self) -> None:
+        """Register this agent's persona header in the engine's prefix
+        arena before traffic arrives: one throwaway 1-token generation of
+        ``"{persona}\\n\\n"`` prefills and caches its bucket-prefixes, so
+        even the FIRST session forks the persona instead of paying its
+        prefill. Matches both serving shapes — the chat path prepends
+        ``f"{system_prompt}\\n\\n{message}"`` and the flattened path opens
+        with ``f"{system_prompt}\\n\\n{history}"``. Best effort."""
+        eng = self.engine
+        if eng is None or not self.system_prompt:
+            return
+        if not getattr(eng, "prefix_cache", False):
+            return
+        try:
+            await eng.generate(
+                prompt=f"{self.system_prompt}\n\n", max_tokens=1, temperature=0.0
+            )
+            self.prefix_prewarms += 1
+        except Exception as e:
+            print(
+                f"[llm-serve] persona prefix prewarm failed for {self.agent_id}: "
+                f"{type(e).__name__}: {e}",
+                flush=True,
+            )
 
     def _notify_ready(self) -> None:
         """Tell the control plane the model is servable so queued requests
@@ -372,6 +412,17 @@ class LLMServeApp:
             def _run() -> None:
                 try:
                     self._load_engine()
+                    if self.engine is not None:
+                        # persona prefixes into the arena BEFORE ready fans
+                        # out: the first replayed request already forks
+                        # them (tenants attached mid-load covered here;
+                        # later attaches prewarm at attach time)
+                        async def _prewarm_all() -> None:
+                            await self._prewarm_prefix()
+                            for tenant, _, _ in list(self._tenants.values()):
+                                await tenant._prewarm_prefix()
+
+                        asyncio.run(_prewarm_all())
                 finally:
                     # set even on loader death: waiters unblock
                     loop.call_soon_threadsafe(self._ready.set)
@@ -435,6 +486,12 @@ class LLMServeApp:
         port = site._server.sockets[0].getsockname()[1]
         self._tenants[aid] = (tenant, runner, port)
         if self.engine is not None:
+            # the tenant's persona goes into the shared engine's prefix
+            # arena right away (its first session forks it, same as the
+            # host's own persona at boot)
+            task = asyncio.ensure_future(tenant._prewarm_prefix())
+            self._bg_tasks.add(task)
+            task.add_done_callback(self._bg_tasks.discard)
             # model already loaded: replay can drain now. Off-loop: the ping
             # is blocking HTTP and must not stall co-tenants' serving.
             asyncio.get_running_loop().run_in_executor(None, tenant._notify_ready)
@@ -589,37 +646,61 @@ class LLMServeApp:
     async def _record_turn(self, session: str, message: str, reply: str) -> None:
         now = time.time()
         try:
+            key = self._convo_session_key(session)
             await self.store.rpush(
-                self.convo_key,
+                key,
                 json.dumps({"role": "user", "content": message, "ts": now, "session": session}),
                 json.dumps(
                     {"role": "assistant", "content": reply, "ts": now, "session": session}
                 ),
             )
-            await self.store.ltrim(self.convo_key, -2 * MAX_TURNS, -1)
+            await self.store.ltrim(key, -2 * MAX_TURNS, -1)
+            await self.store.expire(key, SESSION_CONVO_TTL_S)
         except Exception:
             pass
 
-    async def _flattened_prompt(self, session: str, message: str) -> str:
-        """Persona + the session's last ``history_turns`` exchanges as one
-        prompt string (examples/gemini-agent/app.py:87-113 parity)."""
-        lines: list[str] = []
+    async def _session_turns(self, session: str, window: int) -> list[dict]:
+        """Last ``window`` turns of one session: O(window) read of the
+        per-session list, falling back to the legacy shared key (filter by
+        session in Python) for conversations recorded before the split."""
         try:
-            # full (ltrim-bounded) list, filtered by session BEFORE windowing
-            # — a fixed tail window would let a busy concurrent session evict
-            # this one's history from the prompt
-            raw = await self.store.lrange(self.convo_key, 0, -1)
+            raw = await self.store.lrange(self._convo_session_key(session), -window, -1)
         except Exception:
             raw = []
         turns = []
         for item in raw:
             try:
+                turns.append(json.loads(item))
+            except json.JSONDecodeError:
+                continue
+        if len(turns) >= window:
+            return turns
+        # window not filled by the per-session list: older turns may still
+        # live on the legacy shared key (a conversation recorded before the
+        # split must not lose its pre-split context mid-conversation). The
+        # legacy read fades out as soon as the per-session list fills.
+        legacy_turns = []
+        try:
+            legacy = await self.store.lrange(self.convo_key, 0, -1)
+        except Exception:
+            legacy = []
+        for item in legacy:
+            try:
                 t = json.loads(item)
             except json.JSONDecodeError:
                 continue
             if t.get("session", "default") == session:
-                turns.append(t)
-        for t in turns[-2 * self.history_turns :]:
+                legacy_turns.append(t)
+        return (legacy_turns + turns)[-window:]
+
+    async def _flattened_prompt(self, session: str, message: str) -> str:
+        """Persona + the session's last ``history_turns`` exchanges as one
+        prompt string (examples/gemini-agent/app.py:87-113 parity). The
+        persona + stable history head is also what the engine's prefix
+        arena keys on: turn N+1's prompt shares turn N's token prefix up to
+        where the window slides, so each turn re-prefills only the tail."""
+        lines: list[str] = []
+        for t in await self._session_turns(session, 2 * self.history_turns):
             who = "User" if t.get("role") == "user" else "Assistant"
             lines.append(f"{who}: {t.get('content', '')}")
         lines.append(f"User: {message}")
@@ -647,22 +728,35 @@ class LLMServeApp:
 
     async def h_history(self, request: web.Request) -> web.Response:
         self.requests_total += 1
-        try:
-            raw = await self.store.lrange(self.convo_key, 0, -1)
-        except Exception:
-            raw = []
         turns = []
-        for item in raw:
+        try:
+            # per-session lists plus the legacy shared key (pre-split
+            # turns); merged by timestamp so the combined view reads like
+            # the old single list
+            keys = [self.convo_key] + sorted(
+                await self.store.keys(f"{self.convo_key}:*")
+            )
+        except Exception:
+            keys = [self.convo_key]
+        for key in keys:
             try:
-                turns.append(json.loads(item))
-            except json.JSONDecodeError:
+                raw = await self.store.lrange(key, 0, -1)
+            except Exception:
                 continue
+            for item in raw:
+                try:
+                    turns.append(json.loads(item))
+                except json.JSONDecodeError:
+                    continue
+        turns.sort(key=lambda t: t.get("ts", 0.0))
         return web.json_response({"history": turns, "count": len(turns)})
 
     async def h_clear(self, request: web.Request) -> web.Response:
         self.requests_total += 1
         try:
             await self.store.delete(self.convo_key)
+            for key in await self.store.keys(f"{self.convo_key}:*"):
+                await self.store.delete(key)
             # KV snapshots must go too, or crash-resume would resurrect the
             # conversation the user just asked to forget
             for key in await self.store.keys(f"agent:{self.agent_id}:kvcache:*"):
@@ -733,6 +827,7 @@ class LLMServeApp:
             "kv_snapshots": self.kv_snapshots,
             "kv_snapshots_deferred": self.kv_snapshots_deferred,
             "kv_restores": self.kv_restores,
+            "prefix_prewarms": self.prefix_prewarms,
             "kv_snapshot_errors": self.kv_snapshot_errors,
             "last_kv_snapshot_error": self.last_kv_snapshot_error or None,
             "unhandled_errors": self.unhandled_errors,

@@ -266,6 +266,7 @@ class AgentManager:
                 Keys.agent_metrics_hash(agent_id),
             ]
             doomed += self.store.keys(f"agent:{agent_id}:requests:*")
+            doomed += self.store.keys(Keys.conversations_pattern(agent_id))
             doomed += self.store.keys(Keys.kvcache_pattern(agent_id))
             self.store.delete(*doomed)
         self._fire_route_hook(None, agent_id)

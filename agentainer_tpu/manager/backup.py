@@ -63,6 +63,15 @@ class BackupManager:
         convo = self.store.lrange(Keys.conversations(agent_id), 0, -1)
         if convo:
             state["conversations"] = [c.decode("utf-8", "replace") for c in convo]
+        # per-session conversation lists (the serve layer's write target)
+        by_session = {}
+        for key in self.store.keys(Keys.conversations_pattern(agent_id)):
+            lines = self.store.lrange(key, 0, -1)
+            if lines:
+                session = key.split(":conversations:", 1)[-1]
+                by_session[session] = [c.decode("utf-8", "replace") for c in lines]
+        if by_session:
+            state["conversations_by_session"] = by_session
         kv_keys = self.store.keys(Keys.kvcache_pattern(agent_id))
         if kv_keys:
             state["kvcache"] = {
@@ -112,6 +121,11 @@ class BackupManager:
                 state = manifest.get("app_state", {}).get(old.id, {})
                 for line in state.get("conversations", []):
                     self.store.rpush(Keys.conversations(agent.id), line)
+                for session, lines in state.get("conversations_by_session", {}).items():
+                    for line in lines:
+                        self.store.rpush(
+                            Keys.conversations_session(agent.id, session), line
+                        )
                 for key, blob_b64 in state.get("kvcache", {}).items():
                     session = key.rsplit(":", 1)[-1]
                     self.store.set(Keys.kvcache(agent.id, session), base64.b64decode(blob_b64))

@@ -121,6 +121,18 @@ class MetricsPlane:
                 sample["engine"] = {
                     k: v for k, v in engine_stats.items() if not k.endswith("_samples")
                 }
+                # prefix-arena rollup: ONLY the derived hit rate — the raw
+                # counters (hits/misses/tokens_saved/occupancy/evictions)
+                # are already in the engine dict above; duplicating them
+                # here would double every history sample and split the
+                # source of truth
+                hits = engine_stats.get("prefix_hits")
+                if hits is not None:
+                    lookups = hits + engine_stats.get("prefix_misses", 0)
+                    sample["prefix_cache"] = {
+                        "enabled": engine_stats.get("prefix_cache"),
+                        "hit_rate": round(hits / lookups, 3) if lookups else None,
+                    }
             # host-process half of the picture (CPU%/RSS via /proc): on a
             # TPU-VM the host side is what throttles serving
             if hasattr(self.manager.backend, "host_stats"):

@@ -190,6 +190,8 @@ class StoreClient:
             )
         if op == "delete":
             return _enc(_OP_NUM["delete"], [k])
+        if op == "expire":
+            return _enc(_OP_NUM["expire"], [k, repr(float(kw.get("ttl", 0))).encode()])
         if op == "rpush":
             return _enc(_OP_NUM["rpush"], [k] + [str(v).encode() for v in kw.get("values", [])])
         if op == "lrange":
@@ -227,7 +229,7 @@ class StoreClient:
             return vals[0].decode("utf-8", "replace") if vals else None
         if op == "get_b64":
             return _b64.b64encode(vals[0]).decode() if vals else None
-        if op in ("delete", "rpush", "llen", "hincrby", "lrem"):
+        if op in ("delete", "rpush", "llen", "hincrby", "lrem", "expire"):
             return int(vals[0]) if vals else 0
         if op in ("lrange", "keys"):
             return [v.decode("utf-8", "replace") for v in vals]
@@ -286,6 +288,10 @@ class StoreClient:
             return d.get(key)
         if op == "delete":
             return 1 if d.pop(key, None) is not None else 0
+        if op == "expire":
+            # the in-process fallback dict has no expiry sweeper; standalone
+            # state dies with the process, so acknowledging is correct
+            return 1 if key in d else 0
         if op == "rpush":
             d.setdefault(key, []).extend(kw.get("values", []))
             return len(d[key])
@@ -332,6 +338,9 @@ class StoreClient:
 
     async def delete(self, key: str) -> int:
         return await self._op("delete", key)
+
+    async def expire(self, key: str, ttl: float) -> bool:
+        return bool(await self._op("expire", key, ttl=ttl))
 
     async def rpush(self, key: str, *values: str) -> int:
         return await self._op("rpush", key, values=list(values))

@@ -53,6 +53,7 @@ _STORE_OPS = {
     "set_b64",
     "get_b64",
     "delete",
+    "expire",
     "rpush",
     "lrange",
     "ltrim",
@@ -687,6 +688,8 @@ class ControlPlaneApp:
             return None if raw is None else _b64.b64encode(raw).decode()
         if op == "delete":
             return store.delete(key)
+        if op == "expire":
+            return int(store.expire(key, float(body.get("ttl", 0))))
         if op == "rpush":
             return store.rpush(key, *[v for v in body.get("values", [])])
         if op == "lrange":

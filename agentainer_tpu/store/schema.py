@@ -88,7 +88,18 @@ class Keys:
 
     @staticmethod
     def conversations(agent_id: str) -> str:
+        """Legacy shared conversation list (all sessions interleaved);
+        new turns land on per-session keys (conversations_session)."""
         return f"agent:{agent_id}:conversations"
+
+    @staticmethod
+    def conversations_session(agent_id: str, session: str) -> str:
+        return f"agent:{agent_id}:conversations:{session}"
+
+    @staticmethod
+    def conversations_pattern(agent_id: str) -> str:
+        """Matches the per-session lists only, not the legacy shared key."""
+        return f"agent:{agent_id}:conversations:*"
 
     @staticmethod
     def agent_metrics_hash(agent_id: str) -> str:

@@ -1,0 +1,298 @@
+"""Cross-session prefix KV cache (ISSUE 2 tentpole) + eviction satellites.
+
+The engine's prefix arena caches bucket-length token prefixes the first
+time they are prefilled and FORKS them into a fresh slot on admission, so
+a second session sharing a system prompt prefills only its uncached tail.
+Correctness bar: the forked path must produce bit-identical generations to
+a full prefill (greedy decoding, same weights). Eviction observability:
+session-slot LRU eviction and arena LRU eviction count through the same
+path, and an evicted session re-admits via a prefix hit instead of a full
+re-prefill (after the serve layer re-prepends its persona —
+llm_serve.h_chat's sessions-membership check).
+"""
+
+import asyncio
+import json
+
+from agentainer_tpu.engine.llm import LLMEngine
+from agentainer_tpu.engine.llm_serve import LLMServeApp
+
+
+def _mk(**opts) -> LLMEngine:
+    base = {
+        "max_batch": 4,
+        "max_seq": 256,
+        "decode_chunk": 8,
+        "prefill_chunk": 32,
+    }
+    base.update(opts)
+    return LLMEngine.create("tiny", options=base)
+
+
+# ~90 tokens with the char-level test tokenizer: spans buckets 32 and 64
+SHARED = "the quick brown fox jumps over the lazy dog " * 2
+
+
+def test_second_session_forks_shared_prefix():
+    """Two sessions sharing a prompt prefix: the second forks the cached
+    prefix (hit + tokens_saved at bucket granularity) and generates the
+    EXACT tokens a prefix_cache=false engine produces for the same prompt
+    (same random-init weights, greedy decoding)."""
+    eng = _mk()
+    try:
+
+        async def drive(e):
+            a = await e.generate(SHARED + "alpha", max_tokens=8, temperature=0.0)
+            b = await e.generate(SHARED + "beta", max_tokens=8, temperature=0.0)
+            return a, b
+
+        _, warm = asyncio.run(drive(eng))
+        m = eng.metrics()
+        assert m["prefix_hits"] >= 1, m
+        assert m["prefix_tokens_saved"] >= 64, m
+        assert m["prefix_arena_entries"] >= 2
+        assert 0 < m["prefix_arena_bytes"] <= m["prefix_arena_capacity_bytes"]
+    finally:
+        eng.shutdown()
+
+    base = _mk(prefix_cache=False)
+    try:
+        _, cold = asyncio.run(drive(base))
+        bm = base.metrics()
+        assert bm["prefix_cache"] is False
+        assert bm["prefix_hits"] == 0 and bm["prefix_misses"] == 0
+        assert bm["prefix_arena_entries"] == 0
+        # the forked continuation is bit-identical to the full prefill
+        assert warm["tokens"] == cold["tokens"], (warm["tokens"], cold["tokens"])
+    finally:
+        base.shutdown()
+
+
+def test_arena_lru_evicts_under_bytes_budget():
+    """A tiny bytes budget forces LRU eviction as distinct prefixes
+    register; occupancy never exceeds the budget and evictions are
+    counted through the shared eviction path."""
+    eng = _mk(max_batch=2)
+    # budget for roughly two entries (one 32-bucket entry is
+    # 2 * L * 32 * KV * hd * 4B; derive from the live engine)
+    one = (
+        2
+        * eng.cfg.n_layers
+        * 32
+        * eng.cfg.n_kv_heads
+        * eng.cfg.head_dim
+        * eng.cache.k.dtype.itemsize
+    )
+    eng._prefix_budget = int(2.5 * one)
+    try:
+
+        async def drive():
+            for i in range(4):
+                # distinct prompts: each registers its own 32-bucket prefix
+                await eng.generate(
+                    f"distinct prefix number {i} " * 4, max_tokens=2, temperature=0.0
+                )
+
+        asyncio.run(drive())
+        m = eng.metrics()
+        assert m["prefix_evictions_total"] > 0, m
+        assert m["prefix_arena_bytes"] <= eng._prefix_budget
+        assert m["prefix_eviction_idle_s_p50"] is not None
+    finally:
+        eng.shutdown()
+
+
+def test_session_eviction_counted_with_idle_age():
+    """Session KV eviction at slot-LRU used to be silent: it must count,
+    with the evictee's idle age sampled."""
+    eng = _mk(max_batch=2)
+    try:
+
+        async def drive():
+            await eng.chat("sess-a", "first session", max_tokens=2)
+            await eng.chat("sess-b", "second session", max_tokens=2)
+            await eng.chat("sess-c", "third evicts the LRU", max_tokens=2)
+
+        asyncio.run(drive())
+        m = eng.metrics()
+        assert m["session_evictions_total"] == 1, m
+        assert m["session_eviction_idle_s_p50"] is not None
+        assert m["session_eviction_idle_s_p50"] >= 0
+        assert "sess-a" not in eng.sessions
+    finally:
+        eng.shutdown()
+
+
+def test_evicted_session_readmits_via_prefix_hit():
+    """A session evicted mid-conversation re-admits through the arena: its
+    persona-bearing first turn registered the prefix, so the re-prepended
+    persona forks instead of re-prefilling."""
+    eng = _mk(max_batch=2)
+    try:
+
+        async def drive():
+            await eng.chat("victim", SHARED + "turn one", max_tokens=2)
+            hits_before = eng.prefix_hits
+            # two other sessions evict "victim" (max_batch=2)
+            await eng.chat("other-1", "unrelated words here", max_tokens=2)
+            await eng.chat("other-2", "more unrelated words", max_tokens=2)
+            assert "victim" not in eng.sessions
+            assert eng.session_evictions >= 1
+            # the serve layer re-prepends the persona on the next turn
+            # (session absent from engine.sessions) — same shared prefix
+            saved_before = eng.prefix_tokens_saved
+            await eng.chat("victim", SHARED + "turn two", max_tokens=2)
+            assert eng.prefix_hits > hits_before
+            assert eng.prefix_tokens_saved - saved_before >= 64
+
+        asyncio.run(drive())
+    finally:
+        eng.shutdown()
+
+
+def test_warmup_covers_prefix_fork_ladder():
+    """Every bucket level ≤ max_seq-2 has its slice + fork executables
+    compiled at warmup; a serving-time prefix hit must not compile."""
+    eng = _mk()
+    try:
+        assert set(eng._prefix_levels) == {32, 64, 128}
+        assert set(eng._prefix_slice_fns) == set(eng._prefix_levels)
+        assert set(eng._prefix_fork_fns) == set(eng._prefix_levels)
+        sizes = {b: eng._prefix_fork_fns[b]._cache_size() for b in eng._prefix_levels}
+        assert all(v >= 1 for v in sizes.values()), sizes
+
+        async def drive():
+            await eng.generate(SHARED + "one", max_tokens=2, temperature=0.0)
+            await eng.generate(SHARED + "two", max_tokens=2, temperature=0.0)
+
+        asyncio.run(drive())
+        assert eng.prefix_hits >= 1
+        after = {b: eng._prefix_fork_fns[b]._cache_size() for b in eng._prefix_levels}
+        assert after == sizes, (sizes, after)
+    finally:
+        eng.shutdown()
+
+
+# -- serve-layer halves ---------------------------------------------------
+
+
+class _Req:
+    """Minimal aiohttp-request stand-in for direct handler calls."""
+
+    def __init__(self, body: dict):
+        self._body = body
+        self.headers: dict = {}
+
+    async def json(self):
+        return self._body
+
+
+class _FakeEngine:
+    """Records the prompts the serve layer hands to the engine."""
+
+    prefix_cache = True
+
+    def __init__(self):
+        self.sessions: dict[str, int] = {}
+        self.chats: list[tuple[str, str]] = []
+        self.generates: list[str] = []
+
+    async def chat(self, session, message, max_tokens=64, request_id=""):
+        self.chats.append((session, message))
+        self.sessions[session] = 0
+        return self._result()
+
+    async def generate(self, prompt="", max_tokens=64, temperature=0.0, request_id="", session=""):
+        self.generates.append(prompt)
+        return self._result()
+
+    @staticmethod
+    def _result():
+        return {
+            "text": "ok",
+            "tokens": [1],
+            "prompt_tokens": 3,
+            "completion_tokens": 1,
+            "ttft_ms": 1.0,
+            "ttft_breakdown": None,
+        }
+
+
+def test_persona_reprepended_after_eviction():
+    """Pins llm_serve.h_chat's persona behavior: a brand-new session gets
+    the system prompt prepended, an in-cache session gets the bare
+    message, and an EVICTED session (gone from engine.sessions) gets the
+    persona re-prepended on its next turn."""
+    app = LLMServeApp(
+        env={
+            "AGENTAINER_AGENT_ID": "pfx",
+            "AGENTAINER_SYSTEM_PROMPT": "You are Pfx.",
+        }
+    )
+    eng = _FakeEngine()
+    app.engine = eng
+
+    async def drive():
+        await app.h_chat(_Req({"message": "hi", "session": "s"}))
+        await app.h_chat(_Req({"message": "again", "session": "s"}))
+        eng.sessions.clear()  # engine-side LRU eviction
+        await app.h_chat(_Req({"message": "back", "session": "s"}))
+
+    asyncio.run(drive())
+    assert eng.chats[0] == ("pfx::s", "You are Pfx.\n\nhi")
+    assert eng.chats[1] == ("pfx::s", "again")
+    assert eng.chats[2] == ("pfx::s", "You are Pfx.\n\nback")
+
+
+def test_flattened_history_uses_per_session_keys():
+    """The flattened-assistant flavor reads O(history window) from a
+    per-session list instead of JSON-parsing the whole shared list, with a
+    backward-compatible read of the legacy shared key."""
+    app = LLMServeApp(
+        env={
+            "AGENTAINER_AGENT_ID": "flat",
+            "AGENTAINER_ENGINE": "assistant",
+            "AGENTAINER_SYSTEM_PROMPT": "You are Flat.",
+        }
+    )
+    eng = _FakeEngine()
+    app.engine = eng
+
+    async def drive():
+        await app.h_chat(_Req({"message": "s1 first", "session": "s1"}))
+        await app.h_chat(_Req({"message": "s2 first", "session": "s2"}))
+        await app.h_chat(_Req({"message": "s1 second", "session": "s1"}))
+
+    asyncio.run(drive())
+    # turns recorded on per-session keys, windowed per session
+    local = app.store._local
+    assert len(local["agent:flat:conversations:s1"]) == 4
+    assert len(local["agent:flat:conversations:s2"]) == 2
+    # s1's second prompt carries s1's history but never s2's
+    p = eng.generates[2]
+    assert "s1 first" in p and "s2 first" not in p
+    assert p.startswith("You are Flat.\n\n")
+
+    # legacy shared-key conversations (pre-split) still flatten in
+    local["agent:flat:conversations"] = [
+        json.dumps({"role": "user", "content": "old legacy turn", "ts": 1.0, "session": "old"}),
+        json.dumps({"role": "assistant", "content": "legacy reply", "ts": 1.0, "session": "old"}),
+        json.dumps({"role": "user", "content": "s1 pre-split", "ts": 1.0, "session": "s1"}),
+        json.dumps({"role": "assistant", "content": "pre-split reply", "ts": 1.0, "session": "s1"}),
+    ]
+    prompt = asyncio.run(app._flattened_prompt("old", "continuing"))
+    assert "old legacy turn" in prompt and "legacy reply" in prompt
+    # mid-migration: a session with BOTH pre-split (legacy key) and
+    # post-split (per-session key) turns sees them merged until the
+    # per-session list fills the window — upgrading must not amnesia the
+    # conversation's pre-split context
+    prompt = asyncio.run(app._flattened_prompt("s1", "more"))
+    assert "s1 pre-split" in prompt and "s1 first" in prompt and "s1 second" in prompt
+
+    # /history merges per-session + legacy keys, ordered by timestamp
+    resp = asyncio.run(app.h_history(_Req({})))
+    doc = json.loads(resp.body.decode())
+    contents = [t["content"] for t in doc["history"]]
+    assert "old legacy turn" in contents and "s1 pre-split" in contents
+    assert "s1 first" in contents and "s2 first" in contents
+    assert doc["count"] == 10

@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all native test t1 test-native test-kernels bench server dryrun verify clean
+.PHONY: all native test t1 test-native test-kernels bench overload server dryrun verify clean
 
 all: native
 
@@ -29,6 +29,11 @@ test-kernels:
 # one JSON line: {"metric":..., "value":..., "unit":..., "vs_baseline":...}
 bench: native
 	$(PY) bench.py
+
+# overload/deadline A/B in smoke mode (short duration, tiny model): goodput
+# with shedding on vs off at 2x saturation; full run drops ATPU_OVERLOAD_SMOKE
+overload:
+	JAX_PLATFORMS=cpu ATPU_OVERLOAD_SMOKE=1 $(PY) scripts/bench_overload.py
 
 server: native
 	$(PY) -m agentainer_tpu.cli server

@@ -201,11 +201,29 @@ def cmd_invoke(args) -> None:
 
 
 def cmd_requests(args) -> None:
+    import time as _time
+
     doc = _call(args, "GET", f"/agents/{args.agent_id}/requests?status={args.status}")
     data = doc["data"]
     print(f"stats: {data['stats']}")
     for r in data["requests"]:
-        print(f"  {r['id']}  {r['method']} {r['path']}  {r['status']}  retries={r['retry_count']}")
+        line = f"  {r['id']}  {r['method']} {r['path']}  {r['status']}  retries={r['retry_count']}"
+        if r.get("deadline_at"):
+            remaining = r["deadline_at"] - _time.time()
+            line += f"  deadline={'+' if remaining > 0 else ''}{remaining:.1f}s"
+        if r.get("error"):
+            line += f"  error={r['error']}"
+        print(line)
+
+
+def cmd_requeue(args) -> None:
+    """Put a dead-lettered (failed/expired) request back on the pending
+    queue with retries reset — operator recovery after a transient outage."""
+    doc = _call(
+        args, "POST", f"/agents/{args.agent_id}/requests/{args.request_id}/requeue"
+    )
+    r = doc["data"]
+    print(f"requeued {r['id']} ({r['method']} {r['path']}); replay kicked")
 
 
 def cmd_health(args) -> None:
@@ -370,8 +388,20 @@ def build_parser() -> argparse.ArgumentParser:
 
     s = sub.add_parser("requests", help="journaled requests for an agent")
     s.add_argument("agent_id")
-    s.add_argument("--status", default="pending")
+    s.add_argument(
+        "--status",
+        default="pending",
+        help="pending|processing|completed|failed|expired",
+    )
     s.set_defaults(fn=cmd_requests)
+
+    s = sub.add_parser(
+        "requeue",
+        help="reset a dead-lettered (failed/expired) request back onto pending",
+    )
+    s.add_argument("agent_id")
+    s.add_argument("request_id")
+    s.set_defaults(fn=cmd_requeue)
 
     s = sub.add_parser("health", help="server or agent health")
     s.add_argument("agent_id", nargs="?", default="")

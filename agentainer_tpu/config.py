@@ -43,6 +43,31 @@ class FeatureFlags:
 
 
 @dataclass
+class DeadlineConfig:
+    """End-to-end request deadlines + overload shedding.
+
+    ``enabled: false`` preserves the pre-deadline behavior everywhere
+    (no default deadline, no shedding, no disconnect propagation) — the
+    A/B baseline. Watermarks are depth thresholds at which the proxy
+    answers ``429 + Retry-After`` instead of journaling more work that
+    will expire unserved."""
+
+    enabled: bool = True
+    # default per-request budget when the caller sends no
+    # X-Agentainer-Deadline-Ms header; 0 = no default deadline
+    default_ms: float = 30000.0
+    # per-agent pending-journal depth that starts shedding (0 = off)
+    shed_pending_per_agent: int = 64
+    # global pending ceiling across every agent (0 = off)
+    shed_pending_global: int = 512
+    # engine queue+waiting depth (from the latest metrics sample) that
+    # starts shedding for that agent (0 = don't consult engine depth)
+    engine_queue_watermark: int = 0
+    # Retry-After seconds on shed responses
+    retry_after_s: float = 1.0
+
+
+@dataclass
 class Cadences:
     """Background-loop intervals, reference values (BASELINE.md)."""
 
@@ -58,6 +83,7 @@ class Config:
     slice: SliceConfig = field(default_factory=SliceConfig)
     features: FeatureFlags = field(default_factory=FeatureFlags)
     cadences: Cadences = field(default_factory=Cadences)
+    deadlines: DeadlineConfig = field(default_factory=DeadlineConfig)
     auth_token: str = DEFAULT_TOKEN
     # "auto": native C++ store with AOF durability when the library builds,
     # in-memory store otherwise. Explicit: mem:// | native://[aof-path]
@@ -94,6 +120,21 @@ def load_config(path: str | None = None) -> Config:
     cfg.features.request_persistence = bool(
         feats.get("request_persistence", cfg.features.request_persistence)
     )
+    dl = doc.get("deadlines", {})
+    cfg.deadlines.enabled = bool(dl.get("enabled", cfg.deadlines.enabled))
+    cfg.deadlines.default_ms = float(dl.get("default_ms", cfg.deadlines.default_ms))
+    cfg.deadlines.shed_pending_per_agent = int(
+        dl.get("shed_pending_per_agent", cfg.deadlines.shed_pending_per_agent)
+    )
+    cfg.deadlines.shed_pending_global = int(
+        dl.get("shed_pending_global", cfg.deadlines.shed_pending_global)
+    )
+    cfg.deadlines.engine_queue_watermark = int(
+        dl.get("engine_queue_watermark", cfg.deadlines.engine_queue_watermark)
+    )
+    cfg.deadlines.retry_after_s = float(
+        dl.get("retry_after_s", cfg.deadlines.retry_after_s)
+    )
     sec = doc.get("security", {})
     cfg.auth_token = sec.get("auth_token", cfg.auth_token)
     cfg.store_url = doc.get("store", {}).get("url", cfg.store_url)
@@ -111,6 +152,14 @@ def load_config(path: str | None = None) -> Config:
         cfg.slice.total_chips = int(env["ATPU_SLICE_CHIPS"])
     if "ATPU_SLICE_HOSTS" in env:
         cfg.slice.hosts = int(env["ATPU_SLICE_HOSTS"])
+    if "ATPU_DEADLINES" in env:
+        cfg.deadlines.enabled = env["ATPU_DEADLINES"].lower() in ("1", "true", "yes")
+    if "ATPU_DEADLINE_DEFAULT_MS" in env:
+        cfg.deadlines.default_ms = float(env["ATPU_DEADLINE_DEFAULT_MS"])
+    if "ATPU_SHED_PER_AGENT" in env:
+        cfg.deadlines.shed_pending_per_agent = int(env["ATPU_SHED_PER_AGENT"])
+    if "ATPU_SHED_GLOBAL" in env:
+        cfg.deadlines.shed_pending_global = int(env["ATPU_SHED_GLOBAL"])
     if "ATPU_REQUEST_PERSISTENCE" in env:
         cfg.features.request_persistence = env["ATPU_REQUEST_PERSISTENCE"].lower() in (
             "1",

@@ -57,6 +57,39 @@ class EngineShutdown(RuntimeError):
     into every abandoned future instead of letting callers hang forever."""
 
 
+class RequestAborted(RuntimeError):
+    """Base for per-request terminations that are POLICY, not faults: the
+    request will never produce (more) tokens because nobody is waiting for
+    them. Passed through to callers typed (like EngineShutdown) so the
+    serve layer can map each to its HTTP status."""
+
+
+class RequestExpired(RequestAborted):
+    """Deadline passed before (or while) the request was served."""
+
+
+class RequestCancelled(RequestAborted):
+    """Explicit cancel(request_id) — client disconnected or operator abort."""
+
+
+class EngineOverloaded(RuntimeError):
+    """Submit-time shed: queue+waiting+active depth crossed the watermark.
+    Raised synchronously from generate() BEFORE enqueueing, so overload
+    backpressure costs the caller nothing but this exception. Carries a
+    retry hint for the 429 Retry-After header."""
+
+    def __init__(self, depth: int, watermark: int, retry_after_s: float = 1.0):
+        super().__init__(f"engine overloaded: depth {depth} >= watermark {watermark}")
+        self.depth = depth
+        self.watermark = watermark
+        self.retry_after_s = retry_after_s
+
+
+class EngineDraining(RuntimeError):
+    """SIGTERM drain in progress: no new admissions; in-flight work is
+    being finished and sessions snapshotted before exit."""
+
+
 def _sharded_random_init(cfg: ModelConfig, dtype, mesh, specs: dict) -> dict:
     """Random-init DIRECTLY into shards: ``jit(init, out_shardings=...)``
     makes every chip allocate only its own slice of every weight, so a
@@ -80,6 +113,10 @@ class GenRequest:
     temperature: float
     loop: asyncio.AbstractEventLoop
     future: asyncio.Future
+    # absolute wall-clock give-up instant (None = no deadline): checked at
+    # admission (fail fast before prefill) and per worker iteration while
+    # in flight (park the lane, free the slot)
+    deadline_at: float | None = None
     submitted_at: float = field(default_factory=time.monotonic)
     prefill_started_at: float | None = None
     # final prefill chunk + first-token injection dispatched; the tail of
@@ -191,6 +228,8 @@ class LLMEngine:
         adaptive_decode: bool = True,
         prefix_cache: bool = True,
         prefix_cache_bytes: int = 0,
+        deadlines: bool = True,
+        shed_watermark: int = 0,
     ):
         self.cfg = cfg
         self.tokenizer = tokenizer
@@ -390,6 +429,24 @@ class LLMEngine:
         self.worker_errors = 0
         self.last_worker_error = ""
         self.cache_resets = 0
+        # End-to-end deadline plumbing (deadlines=False is the A/B baseline:
+        # no expiry checks, no overload shed — exactly the prior behavior;
+        # explicit cancel() still works, it is an API, not policy).
+        self.deadlines = bool(deadlines)
+        # submit-time shed watermark on queue+waiting+active depth; 0 = off
+        # (the historical unbounded queue). The serve layer maps the raised
+        # EngineOverloaded to 429 + Retry-After.
+        self.shed_watermark = max(0, int(shed_watermark))
+        # request-id → cancel-record time (guarded by self._lock). TTL'd:
+        # a cancel for an id the engine never ends up seeing (client died
+        # before its dispatch arrived) must not poison a LATER legitimate
+        # dispatch of the same id (operator requeue) nor accumulate forever.
+        self._cancel_requested: dict[str, float] = {}
+        self._cancel_ttl_s = 30.0
+        self._draining = False
+        self.cancelled_total = 0
+        self.expired_total = 0
+        self.shed_total = 0
         self._snap_fns: dict[int, Any] = {}
         # global limiter: one snapshot staging per gap — the readback rides
         # the same device stream decode lives on (a bucket-128 8B snapshot
@@ -590,6 +647,8 @@ class LLMEngine:
                 adaptive_decode=bool(options.get("adaptive_decode", True)),
                 prefix_cache=bool(options.get("prefix_cache", True)),
                 prefix_cache_bytes=int(options.get("prefix_cache_bytes", 0) or 0),
+                deadlines=bool(options.get("deadlines", True)),
+                shed_watermark=int(options.get("shed_watermark", 0) or 0),
             )
             if not options.get("skip_warmup"):
                 engine.warmup()
@@ -711,6 +770,8 @@ class LLMEngine:
             adaptive_decode=bool(options.get("adaptive_decode", True)),
             prefix_cache=bool(options.get("prefix_cache", True)),
             prefix_cache_bytes=int(options.get("prefix_cache_bytes", 0) or 0),
+            deadlines=bool(options.get("deadlines", True)),
+            shed_watermark=int(options.get("shed_watermark", 0) or 0),
         )
         # pay the decode/prefill compiles here (inside the loader thread, while
         # /health keeps answering) instead of on the first user request.
@@ -958,6 +1019,21 @@ class LLMEngine:
         self._started_at = time.monotonic()
 
     # -- public API (called from the aiohttp loop) ------------------------
+    def load_depth(self) -> int:
+        """Submit-side load estimate: queued + drained-but-unadmitted +
+        in-flight GENERATION requests. Snapshot/restore commands ride the
+        same queue but are not admission load — counting them would shed
+        serveable traffic whenever per-turn KV snapshots burst. Approximate
+        by design: admission control needs a watermark comparison, not an
+        exact census."""
+        with self._queue.mutex:
+            queued = sum(1 for it in self._queue.queue if isinstance(it, GenRequest))
+        return (
+            queued
+            + sum(1 for it in self._waiting if isinstance(it, GenRequest))
+            + sum(1 for s in self.slots if s.request is not None)
+        )
+
     async def generate(
         self,
         prompt: str,
@@ -965,12 +1041,20 @@ class LLMEngine:
         temperature: float = 0.0,
         request_id: str = "",
         session: str = "",
+        deadline_at: float | None = None,
     ) -> dict:
         if request_id:
             with self._lock:
                 hit = self._completed.get(request_id)
             if hit is not None:
                 return dict(hit, replayed=True)
+        if self._draining:
+            raise EngineDraining("engine draining for shutdown")
+        if self.deadlines and self.shed_watermark:
+            depth = self.load_depth()
+            if depth >= self.shed_watermark:
+                self.shed_total += 1
+                raise EngineOverloaded(depth, self.shed_watermark)
         loop = asyncio.get_running_loop()
         prompt_ids = self.tokenizer.encode(prompt)
         req = GenRequest(
@@ -981,6 +1065,7 @@ class LLMEngine:
             temperature=temperature,
             loop=loop,
             future=loop.create_future(),
+            deadline_at=deadline_at if self.deadlines else None,
         )
         self._queue.put(req)
         result = await req.future
@@ -992,7 +1077,12 @@ class LLMEngine:
         return result
 
     async def chat(
-        self, session: str, message: str, max_tokens: int = 64, request_id: str = ""
+        self,
+        session: str,
+        message: str,
+        max_tokens: int = 64,
+        request_id: str = "",
+        deadline_at: float | None = None,
     ) -> dict:
         return await self.generate(
             prompt=message,
@@ -1000,7 +1090,23 @@ class LLMEngine:
             temperature=0.0,
             request_id=request_id,
             session=session or "default",
+            deadline_at=deadline_at,
         )
+
+    def cancel(self, request_id: str) -> bool:
+        """Request-id cancel path (client disconnected / operator abort).
+        Queued or waiting items are rejected before prefill; an in-flight
+        lane is parked mid-decode on the next worker iteration and its slot
+        freed for admission. Returns False for ids already completed (the
+        memoized result stands — a replay may still claim it); True means
+        the abort was recorded and the worker will act on it."""
+        if not request_id:
+            return False
+        with self._lock:
+            if request_id in self._completed:
+                return False
+            self._cancel_requested[request_id] = time.monotonic()
+        return True
 
     async def snapshot_session(self, session: str) -> bytes | None:
         """Serialize a session's live KV prefix for the store.
@@ -1335,6 +1441,18 @@ class LLMEngine:
             "worker_errors": self.worker_errors,
             "last_worker_error": self.last_worker_error or None,
             "cache_resets": self.cache_resets,
+            # request-lifecycle policy plane: deadlines/cancel/shed state.
+            # queue_depth/waiting_depth/active_requests are the admission
+            # picture the control plane's shedding watermark reads.
+            "deadlines": self.deadlines,
+            "queue_depth": self._queue.qsize(),
+            "waiting_depth": len(self._waiting),
+            "active_requests": sum(1 for s in self.slots if s.request is not None),
+            "cancelled_total": self.cancelled_total,
+            "expired_total": self.expired_total,
+            "shed_total": self.shed_total,
+            "shed_watermark": self.shed_watermark or None,
+            "draining": self._draining,
             # prefix arena (cross-session KV reuse): hit/miss/saved counters
             # plus occupancy — tokens_saved is prefill work the fork skipped
             "prefix_cache": self.prefix_cache,
@@ -1396,6 +1514,35 @@ class LLMEngine:
             ),
         }
 
+    def begin_drain(self) -> None:
+        """Stop admitting (generate() raises EngineDraining); in-flight and
+        already-queued work keeps running. First half of graceful SIGTERM."""
+        self._draining = True
+
+    def drain(self, budget_s: float = 10.0) -> bool:
+        """Block until every queued/waiting/in-flight request settles, up to
+        ``budget_s``; returns True on a clean drain. Called off the worker
+        thread (serve-layer cleanup). Work still live when the budget runs
+        out is failed by the caller's subsequent shutdown()."""
+        self.begin_drain()
+
+        def busy() -> bool:
+            return bool(
+                any(s.request is not None for s in self.slots)
+                or self._waiting
+                or not self._queue.empty()
+                or self._readbacks
+            )
+
+        deadline = time.monotonic() + max(0.0, budget_s)
+        while time.monotonic() < deadline:
+            if not busy():
+                return True
+            time.sleep(0.05)
+        # same predicate at the budget's edge: queued/waiting leftovers the
+        # subsequent shutdown() will fail must not report drained_clean
+        return not busy()
+
     def shutdown(self) -> None:
         self._running = False
         self._queue.put(None)
@@ -1427,6 +1574,10 @@ class LLMEngine:
             if self._sentinel:
                 break
             self._admit_waiting()
+            # cancelled/expired in-flight lanes are reaped BEFORE dispatching
+            # more device work for them; their freed slots are admissible on
+            # the next iteration's _admit_waiting pass
+            self._reap_aborted()
             # ONE prefill chunk, then a decode chunk: a long prompt is fed
             # through chunk-by-chunk between decode chunks, so admitting it
             # never stalls active generations for more than one chunk's
@@ -1521,6 +1672,8 @@ class LLMEngine:
                     self._do_restore(item)
                 elif isinstance(item, SnapshotCmd):
                     self._do_snapshot(item)
+                elif self._pre_reject(item):
+                    pass  # expired/cancelled before prefill — already failed
                 elif not self._try_admit(item):
                     still.append(item)
             except Exception as e:
@@ -1528,6 +1681,95 @@ class LLMEngine:
                 self._note_error(e)
                 self._fail_item(item, e)
         self._waiting = still
+
+    def _take_cancel(self, request_id: str) -> bool:
+        with self._lock:
+            return self._cancel_requested.pop(request_id, None) is not None
+
+    def _purge_stale_cancels(self) -> None:
+        """Drop cancel markers whose request never showed up (TTL): the
+        client-disconnect path can record a cancel for a dispatch that died
+        on the wire before the engine saw it."""
+        if not self._cancel_requested:
+            return
+        cutoff = time.monotonic() - self._cancel_ttl_s
+        with self._lock:
+            for rid in [r for r, t in self._cancel_requested.items() if t < cutoff]:
+                del self._cancel_requested[rid]
+
+    def _pre_reject(self, req: GenRequest) -> bool:
+        """Fail a not-yet-admitted request whose caller is gone: cancelled
+        ids and past-deadline arrivals never reach prefill — the whole point
+        of the admission-side check is that a deadline miss costs ZERO
+        device work."""
+        if self._take_cancel(req.id):
+            self.cancelled_total += 1
+            self._fail_item(req, RequestCancelled(f"request {req.id} cancelled"))
+            return True
+        if self.deadlines and req.deadline_at is not None and time.time() > req.deadline_at:
+            self.expired_total += 1
+            self._fail_item(
+                req, RequestExpired(f"request {req.id} deadline exceeded before prefill")
+            )
+            return True
+        return False
+
+    def _reap_aborted(self) -> None:
+        """Per-iteration sweep of in-flight lanes: a cancelled request (or
+        one whose deadline passed mid-generation) is parked mid-decode and
+        its slot freed for admission — decoding on for a caller that is gone
+        is pure waste under overload. In-flight readback entries for the
+        reaped request are skipped at processing (request-identity check),
+        the same staleness discipline finished lanes already use."""
+        self._purge_stale_cancels()
+        if not self._cancel_requested and not (
+            self.deadlines
+            and any(
+                s.request is not None and s.request.deadline_at is not None
+                for s in self.slots
+            )
+        ):
+            return
+        now = time.time()
+        for slot in self.slots:
+            req = slot.request
+            if req is None:
+                continue
+            if self._take_cancel(req.id):
+                self.cancelled_total += 1
+                err: Exception = RequestCancelled(f"request {req.id} cancelled mid-flight")
+            elif (
+                self.deadlines
+                and req.deadline_at is not None
+                and now > req.deadline_at
+            ):
+                self.expired_total += 1
+                err = RequestExpired(f"request {req.id} deadline exceeded mid-flight")
+            else:
+                continue
+            self._fail_item(req, err)
+            self._abandon_slot(slot)
+
+    def _abandon_slot(self, slot: Slot) -> None:
+        """Free a slot whose request was aborted mid-flight: park its decode
+        lane (chunks already dispatched keep stepping it until the park
+        injection lands, their tokens skipped at processing), then return
+        the slot to cold idle — the KV prefix holds a partial generation the
+        session's recorded history will never contain, so continuing from it
+        would desync context."""
+        if slot.decoding:
+            slot.decoding = False
+            slot.dev_position = self.scratch_pos
+            self._dtok, self._dpos, self._dtemps = self._inject(
+                self._dtok,
+                self._dpos,
+                self._dtemps,
+                jnp.int32(slot.idx),
+                jnp.int32(0),
+                jnp.int32(self.scratch_pos),
+                jnp.float32(0.0),
+            )
+        self._reset_slot(slot)
 
     def _has_dispatchable(self) -> bool:
         """Is there device work left to dispatch? Pending prompt chunks, or
@@ -1862,6 +2104,9 @@ class LLMEngine:
             "ttft_breakdown": breakdown,
         }
         req.loop.call_soon_threadsafe(_resolve, req.future, result)
+        # a cancel that raced a natural finish loses: drop its stale marker
+        with self._lock:
+            self._cancel_requested.pop(req.id, None)
         # settle point: the slot is idle RIGHT NOW — stage any snapshot that
         # parked while this request was generating
         self._service_parked_snapshot(slot)
@@ -2105,7 +2350,7 @@ def _resolve_value(future: asyncio.Future, value) -> None:
 
 def _reject(future: asyncio.Future, error: Exception) -> None:
     if not future.done():
-        if isinstance(error, EngineShutdown):
+        if isinstance(error, (EngineShutdown, RequestAborted)):
             future.set_exception(error)  # callers can catch the type
         else:
             future.set_exception(RuntimeError(f"engine worker error: {error}"))

@@ -30,7 +30,14 @@ MAX_TURNS = 50
 # permanent (ltrim-bounded) list behind — the old shared key was bounded
 # in TOTAL size, the per-session split must be bounded in key count too
 SESSION_CONVO_TTL_S = 7 * 24 * 3600
-LOADING_HEADER = "X-Agentainer-Loading"
+# proxy ↔ engine wire headers: single definition site shared with the
+# control plane (core/protocol.py) — re-exported for existing importers
+from ..core.protocol import (  # noqa: E402, F401  (re-export)
+    DEADLINE_HEADER,
+    DRAINING_HEADER,
+    EXPIRED_HEADER,
+    LOADING_HEADER,
+)
 
 
 class LLMServeApp:
@@ -115,6 +122,19 @@ class LLMServeApp:
         self.unhandled_errors = 0
         self.last_unhandled_error = ""
         self._bg_tasks: set[asyncio.Task] = set()  # keep snapshot tasks alive
+        # graceful-drain state (SIGTERM path): drain budget, outcome, and
+        # how many sessions got a final durability snapshot
+        try:
+            self.drain_budget_s = float(
+                self.model_options.get(
+                    "drain_budget_s", E.get("AGENTAINER_DRAIN_BUDGET_S", 10.0)
+                )
+            )
+        except (TypeError, ValueError):
+            self.drain_budget_s = 10.0
+        self.draining = False
+        self.drained_clean: bool | None = None
+        self.drain_snapshots = 0
 
     # engine + load state delegate to the host when this app is a tenant:
     # one LLMEngine (one weight copy) serves every attached agent
@@ -159,6 +179,50 @@ class LLMServeApp:
 
     def _kv_key(self, session: str) -> str:
         return f"agent:{self.agent_id}:kvcache:{session}"
+
+    def _deadline_from(self, request: web.Request) -> float | None:
+        """Absolute give-up instant from the deadline header (remaining ms),
+        falling back to the deploy-config default. None = no deadline."""
+        raw = request.headers.get(DEADLINE_HEADER, "")
+        if not raw:
+            raw = self.model_options.get("default_deadline_ms", "")
+        try:
+            ms = float(raw)
+        except (TypeError, ValueError):
+            return None
+        return time.time() + ms / 1000.0 if ms > 0 else None
+
+    def _policy_response(self, e: BaseException) -> web.Response | None:
+        """Map engine lifecycle-policy rejections to HTTP. Returns None for
+        anything that is a real error (the json_errors middleware owns it)."""
+        from .llm import EngineDraining, EngineOverloaded, RequestCancelled, RequestExpired
+
+        if isinstance(e, EngineOverloaded):
+            return web.json_response(
+                {"error": str(e), "depth": e.depth, "watermark": e.watermark},
+                status=429,
+                headers={"Retry-After": str(max(1, int(round(e.retry_after_s))))},
+            )
+        if isinstance(e, EngineDraining):
+            return web.json_response(
+                {"error": "engine draining for restart"},
+                status=503,
+                headers={DRAINING_HEADER: "true", "Retry-After": "5"},
+            )
+        if isinstance(e, RequestExpired):
+            return web.json_response(
+                {"error": str(e)}, status=504, headers={EXPIRED_HEADER: "true"}
+            )
+        if isinstance(e, RequestCancelled):
+            # same dead-letter marker as expiry: the proxy must not archive
+            # a cancellation notice as the request's completed response
+            return web.json_response(
+                {"error": str(e)},
+                status=499,
+                reason="Client Closed Request",
+                headers={EXPIRED_HEADER: "true"},
+            )
+        return None
 
     async def _snapshot_session(self, session: str) -> None:
         """Fire-and-forget KV snapshot after a turn settles (async host
@@ -384,6 +448,7 @@ class LLMServeApp:
         app.router.add_post("/chat", self.h_chat)
         app.router.add_post("/generate", self.h_generate)
         app.router.add_get("/history", self.h_history)
+        app.router.add_post("/cancel", self.h_cancel)
         app.router.add_post("/clear", self.h_clear)
         app.router.add_get("/metrics", self.h_metrics)
         app.router.add_post("/profile", self.h_profile)
@@ -432,6 +497,13 @@ class LLMServeApp:
             threading.Thread(target=_run, daemon=True, name="model-loader").start()
 
         async def cleanup(app):
+            # graceful drain BEFORE detaching tenants: their resident
+            # sessions get a final durability snapshot while the engine
+            # still holds them — so a rolling restart resumes every
+            # tenant's conversation token-identical instead of looking
+            # like a crash
+            if self._host is None and self.engine is not None:
+                await self._graceful_drain()
             for aid in list(self._tenants):
                 await self._detach_tenant(aid)
             if self._host is None and self.engine is not None:
@@ -441,6 +513,54 @@ class LLMServeApp:
         app.on_startup.append(boot)
         app.on_cleanup.append(cleanup)
         return app
+
+    async def _graceful_drain(self) -> None:
+        """SIGTERM half of a rolling restart: stop admitting, let in-flight
+        lanes finish inside the drain budget, then snapshot every resident
+        session (the host's AND still-attached tenants') so the respawned
+        engine restores them token-identical. Queued journal entries replay
+        on respawn — the drain makes a planned restart lossless, not
+        crash-shaped."""
+        eng = self.engine
+        if eng is None:
+            return
+        self.draining = True
+        self.drained_clean = await asyncio.to_thread(eng.drain, self.drain_budget_s)
+        # the engine is idle now (or the budget ran out): lift the snapshot
+        # limiter — its job is protecting in-flight decode from readback
+        # traffic, and there is none left to protect
+        eng.snapshot_min_gap_s = 0.0
+        eng.snapshot_busy_gap_s = 0.0
+        for app_ in [self] + [t for t, _, _ in self._tenants.values()]:
+            if not app_.store.connected:
+                continue
+            prefix = f"{app_.agent_id}::"
+            for name in [s for s in list(eng.sessions) if s.startswith(prefix)]:
+                before = app_.kv_snapshots
+                try:
+                    await app_._snapshot_now(name[len(prefix):])
+                except Exception:
+                    continue  # _snapshot_now already counted/logged it
+                if app_.kv_snapshots > before:
+                    self.drain_snapshots += 1
+
+    async def h_cancel(self, request: web.Request) -> web.Response:
+        """Abort a request by id (the proxy calls this when the client
+        disconnects mid-dispatch; operators can too). Queued work is
+        rejected before prefill; an in-flight lane is parked mid-decode and
+        its slot freed."""
+        self.requests_total += 1
+        err = await self._ensure_engine()
+        if err is not None:
+            return err
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        rid = str(body.get("request_id", ""))
+        if not rid:
+            return web.json_response({"error": "request_id required"}, status=400)
+        return web.json_response({"cancelled": bool(self.engine.cancel(rid))})
 
     # -- multi-tenant host admin (backend-only; VERDICT r4 item 5) --------
     def _check_host_auth(self, request: web.Request) -> bool:
@@ -530,9 +650,10 @@ class LLMServeApp:
 
     async def h_health(self, request: web.Request) -> web.Response:
         self.requests_total += 1
+        host = self._host if self._host is not None else self
         return web.json_response(
             {
-                "status": "healthy",
+                "status": "draining" if host.draining else "healthy",
                 "agent_id": self.agent_id,
                 "model_loaded": self.engine is not None,
                 "uptime_s": time.time() - self.started_at,
@@ -574,14 +695,28 @@ class LLMServeApp:
         session = str(body.get("session", "default"))
         max_tokens = int(body.get("max_tokens", 64))
         request_id = request.headers.get("X-Agentainer-Request-ID", "")
+        # kwarg only when a deadline is actually set: duck-typed engine
+        # doubles (and the echo engine's contract) stay compatible
+        dl_kw = (
+            {"deadline_at": dl} if (dl := self._deadline_from(request)) is not None else {}
+        )
 
         if self.flatten_history:
             # gemini-agent-style turn: persona + last-N exchanges flattened
             # into ONE prompt string, generated statelessly (no KV session)
             prompt = await self._flattened_prompt(session, message)
-            result = await self.engine.generate(
-                prompt=prompt, max_tokens=max_tokens, request_id=request_id
-            )
+            try:
+                result = await self.engine.generate(
+                    prompt=prompt,
+                    max_tokens=max_tokens,
+                    request_id=request_id,
+                    **dl_kw,
+                )
+            except Exception as e:
+                resp = self._policy_response(e)
+                if resp is None:
+                    raise
+                return resp
             await self._record_turn(session, message, result["text"])
             return web.json_response(
                 {
@@ -618,12 +753,19 @@ class LLMServeApp:
         if self.system_prompt and self._sess(session) not in self.engine.sessions:
             prompt = f"{self.system_prompt}\n\n{message}"
 
-        result = await self.engine.chat(
-            session=self._sess(session),
-            message=prompt,
-            max_tokens=max_tokens,
-            request_id=request_id,
-        )
+        try:
+            result = await self.engine.chat(
+                session=self._sess(session),
+                message=prompt,
+                max_tokens=max_tokens,
+                request_id=request_id,
+                **dl_kw,
+            )
+        except Exception as e:
+            resp = self._policy_response(e)
+            if resp is None:
+                raise
+            return resp
         if self.store.connected:
             task = asyncio.ensure_future(self._snapshot_session(session))
             self._bg_tasks.add(task)  # an unreferenced task can be GC'd mid-flight
@@ -718,12 +860,22 @@ class LLMServeApp:
             body = await request.json()
         except json.JSONDecodeError:
             return web.json_response({"error": "invalid JSON"}, status=400)
-        result = await self.engine.generate(
-            prompt=str(body.get("prompt", "")),
-            max_tokens=int(body.get("max_tokens", 64)),
-            temperature=float(body.get("temperature", 0.0)),
-            request_id=request.headers.get("X-Agentainer-Request-ID", ""),
+        dl_kw = (
+            {"deadline_at": dl} if (dl := self._deadline_from(request)) is not None else {}
         )
+        try:
+            result = await self.engine.generate(
+                prompt=str(body.get("prompt", "")),
+                max_tokens=int(body.get("max_tokens", 64)),
+                temperature=float(body.get("temperature", 0.0)),
+                request_id=request.headers.get("X-Agentainer-Request-ID", ""),
+                **dl_kw,
+            )
+        except Exception as e:
+            resp = self._policy_response(e)
+            if resp is None:
+                raise
+            return resp
         return web.json_response(result)
 
     async def h_history(self, request: web.Request) -> web.Response:
@@ -832,6 +984,9 @@ class LLMServeApp:
             "last_kv_snapshot_error": self.last_kv_snapshot_error or None,
             "unhandled_errors": self.unhandled_errors,
             "last_unhandled_error": self.last_unhandled_error or None,
+            "drain_budget_s": self.drain_budget_s,
+            "drained_clean": self.drained_clean,
+            "drain_snapshots": self.drain_snapshots,
         }
         if self._host is not None or self._tenants:
             # HBM audit for the sharing demo: engine-level hbm byte counts

@@ -16,6 +16,7 @@ the reference only dedupes at the proxy via the X-Agentainer-Replay header
 from __future__ import annotations
 
 import base64
+import json
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -32,6 +33,9 @@ class RequestStatus:
     PROCESSING = "processing"
     COMPLETED = "completed"
     FAILED = "failed"
+    # deadline passed before the request could be served: dead-lettered
+    # without charging a retry — nobody is waiting for the answer anymore
+    EXPIRED = "expired"
 
 
 @dataclass
@@ -51,6 +55,12 @@ class JournaledRequest:
     error: str = ""
     created_at: float = field(default_factory=time.time)
     updated_at: float = field(default_factory=time.time)
+    # absolute wall-clock instant after which the caller has given up; None
+    # = no deadline (pre-deadline entries and deadlines=false deployments)
+    deadline_at: float | None = None
+
+    def expired(self, now: float | None = None) -> bool:
+        return self.deadline_at is not None and (now or time.time()) > self.deadline_at
 
     @property
     def body(self) -> bytes:
@@ -71,6 +81,7 @@ class JournaledRequest:
             "error": self.error,
             "created_at": self.created_at,
             "updated_at": self.updated_at,
+            "deadline_at": self.deadline_at,
         }
 
     @staticmethod
@@ -89,6 +100,9 @@ class JournaledRequest:
             error=d.get("error", ""),
             created_at=float(d.get("created_at", 0)),
             updated_at=float(d.get("updated_at", 0)),
+            deadline_at=(
+                float(d["deadline_at"]) if d.get("deadline_at") is not None else None
+            ),
         )
 
 
@@ -114,6 +128,7 @@ class RequestJournal:
         headers: dict[str, str] | None = None,
         body: bytes = b"",
         request_id: str | None = None,
+        deadline_at: float | None = None,
     ) -> JournaledRequest:
         req = JournaledRequest(
             id=request_id or str(uuid.uuid4()),
@@ -122,6 +137,7 @@ class RequestJournal:
             path=path,
             headers=dict(headers or {}),
             body_b64=base64.b64encode(body).decode() if body else "",
+            deadline_at=deadline_at,
         )
         self.store.set_json(
             Keys.request(agent_id, req.id), req.to_dict(), ttl=self.ttl_s
@@ -154,11 +170,36 @@ class RequestJournal:
         self.store.lrem(Keys.pending(agent_id), 1, request_id)
         self.store.rpush(Keys.completed(agent_id), request_id)
 
+    def acquire_processing(self, agent_id: str, request_id: str) -> bool:
+        """Claim the pending→processing transition with a store-level
+        compare-and-set; returns whether THIS caller won the claim.
+
+        mark_processing used to be a read-modify-write: proxy dispatch and a
+        replay tick could both read PENDING across an await boundary and
+        dispatch the same entry twice before the engine's idempotency memo
+        existed. The CAS closes that: exactly one dispatcher sees True; the
+        loser backs off without forwarding anything. A concurrent unrelated
+        touch (retry accounting from another dispatch) fails the swap too —
+        re-read and retry, bounded."""
+        key = Keys.request(agent_id, request_id)
+        for _ in range(4):
+            raw = self.store.get(key)
+            if raw is None:
+                return False
+            req = JournaledRequest.from_dict(json.loads(raw))
+            if req.status != RequestStatus.PENDING:
+                return False
+            req.status = RequestStatus.PROCESSING
+            req.updated_at = time.time()
+            new = json.dumps(req.to_dict(), separators=(",", ":"))
+            if self.store.cas(key, raw, new):
+                return True
+        return False
+
     def mark_processing(self, agent_id: str, request_id: str) -> None:
-        """Flag an in-flight dispatch so a racing replay pass cannot run the
-        same request twice (the duplicate-execution gap the reference has:
-        its worker re-reads the whole pending list every 5s tick,
-        replay_worker.go:60-118)."""
+        """Best-effort processing flag for forced re-dispatch paths (manual
+        replay of settled entries); racing dispatchers must use
+        acquire_processing instead."""
         req = self.get(agent_id, request_id)
         if req is not None and req.status == RequestStatus.PENDING:
             req.status = RequestStatus.PROCESSING
@@ -189,18 +230,74 @@ class RequestJournal:
             req.status = RequestStatus.PENDING
             self._save(req)
 
+    def mark_expired(self, agent_id: str, request_id: str, reason: str = "") -> None:
+        """Dead-letter an entry whose deadline passed (or whose caller
+        disconnected): off the pending list, onto the ``expired`` list, no
+        retry charged. Replaying it would burn engine time on an answer
+        nobody reads."""
+        req = self.get(agent_id, request_id)
+        if req is None or req.status in (RequestStatus.COMPLETED, RequestStatus.EXPIRED):
+            return
+        req.status = RequestStatus.EXPIRED
+        if reason:
+            req.error = reason
+        self._save(req)
+        self.store.lrem(Keys.pending(agent_id), 1, request_id)
+        self.store.rpush(Keys.expired(agent_id), request_id)
+
+    def requeue(self, agent_id: str, request_id: str) -> JournaledRequest | None:
+        """Operator recovery: put a dead-lettered (failed/expired) entry back
+        on the pending list with retry_count reset, so a transient-outage
+        victim replays without hand-editing the store. The deadline is
+        cleared — the operator asking for a requeue IS the new waiter, and
+        the stale deadline would expire it again immediately. The status
+        flip is a CAS (same discipline as acquire_processing): of two
+        concurrent requeues exactly one does the list moves, so the id can
+        never land on the pending list twice."""
+        key = Keys.request(agent_id, request_id)
+        for _ in range(4):
+            raw = self.store.get(key)
+            if raw is None:
+                return None
+            req = JournaledRequest.from_dict(json.loads(raw))
+            if req.status not in (RequestStatus.FAILED, RequestStatus.EXPIRED):
+                return None
+            source = (
+                Keys.failed(agent_id)
+                if req.status == RequestStatus.FAILED
+                else Keys.expired(agent_id)
+            )
+            req.status = RequestStatus.PENDING
+            req.retry_count = 0
+            req.error = ""
+            req.deadline_at = None
+            req.updated_at = time.time()
+            new = json.dumps(req.to_dict(), separators=(",", ":"))
+            if self.store.cas(key, raw, new):
+                self.store.lrem(source, 1, request_id)
+                self.store.rpush(Keys.pending(agent_id), request_id)
+                return req
+        return None
+
     def pending_ids(self, agent_id: str) -> list[str]:
         return self.store.lrange_str(Keys.pending(agent_id), 0, -1)
 
     def pending(self, agent_id: str) -> list[JournaledRequest]:
+        """Live pending entries. Entries whose deadline has passed are
+        dead-lettered to the ``expired`` list here — both the replay worker
+        and the proxy's depth accounting read through this path, so a
+        crash-stale queue self-cleans instead of replaying hours-dead work."""
         out = []
+        now = time.time()
         for rid in self.pending_ids(agent_id):
             req = self.get(agent_id, rid)
-            if req is not None:
-                out.append(req)
-            else:
+            if req is None:
                 # record expired (24h TTL) — drop the dangling id
                 self.store.lrem(Keys.pending(agent_id), 1, rid)
+            elif req.expired(now):
+                self.mark_expired(agent_id, rid, reason="deadline exceeded")
+            else:
+                out.append(req)
         return out
 
     def by_status(self, agent_id: str, status: str) -> list[JournaledRequest]:
@@ -212,12 +309,14 @@ class RequestJournal:
             key = Keys.completed(agent_id)
         elif status == RequestStatus.FAILED:
             key = Keys.failed(agent_id)
+        elif status == RequestStatus.EXPIRED:
+            key = Keys.expired(agent_id)
         else:
             from ..core.errors import InvalidInput
 
             raise InvalidInput(
                 f"unknown request status {status!r}; known: pending, processing, "
-                "completed, failed"
+                "completed, failed, expired"
             )
         out = []
         for rid in self.store.lrange_str(key, 0, -1):
@@ -231,7 +330,20 @@ class RequestJournal:
             "pending": self.store.llen(Keys.pending(agent_id)),
             "completed": self.store.llen(Keys.completed(agent_id)),
             "failed": self.store.llen(Keys.failed(agent_id)),
+            "expired": self.store.llen(Keys.expired(agent_id)),
         }
+
+    def pending_depth(self, agent_id: str) -> int:
+        """O(1) queue depth for admission decisions (proxy shedding)."""
+        return self.store.llen(Keys.pending(agent_id))
+
+    def total_pending(self) -> int:
+        """Pending depth summed across every agent — the global shedding
+        ceiling's input. SCAN-style like agents_with_pending."""
+        total = 0
+        for key in self.store.scan(Keys.PENDING_PATTERN):
+            total += self.store.llen(key)
+        return total
 
     def agents_with_pending(self) -> list[str]:
         """Agents that currently have queued requests.

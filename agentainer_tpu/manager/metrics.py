@@ -48,16 +48,27 @@ class MetricsPlane:
     def count_request(self, agent_id: str, latency_s: float = 0.0) -> None:
         with self._lock:
             c = self._counters.setdefault(
-                agent_id, {"requests": 0, "latency_sum": 0.0, "latency_max": 0.0}
+                agent_id,
+                {"requests": 0, "latency_sum": 0.0, "latency_max": 0.0, "shed": 0},
             )
             c["requests"] += 1
             c["latency_sum"] += latency_s
             c["latency_max"] = max(c["latency_max"], latency_s)
 
+    def count_shed(self, agent_id: str) -> None:
+        """A request the proxy answered 429 for instead of journaling —
+        the overload-shedding half of the deadline plane."""
+        with self._lock:
+            c = self._counters.setdefault(
+                agent_id,
+                {"requests": 0, "latency_sum": 0.0, "latency_max": 0.0, "shed": 0},
+            )
+            c["shed"] = c.get("shed", 0) + 1
+
     def _drain_counters(self, agent_id: str) -> dict:
         with self._lock:
             c = self._counters.pop(agent_id, None)
-        c = c or {"requests": 0, "latency_sum": 0.0, "latency_max": 0.0}
+        c = c or {"requests": 0, "latency_sum": 0.0, "latency_max": 0.0, "shed": 0}
         if self._native_drain is not None:
             try:
                 n = self._native_drain(agent_id)
@@ -67,11 +78,17 @@ class MetricsPlane:
             except Exception:
                 pass
         if not c["requests"]:
-            return {"requests": 0, "latency_avg_s": 0.0, "latency_max_s": 0.0}
+            return {
+                "requests": 0,
+                "latency_avg_s": 0.0,
+                "latency_max_s": 0.0,
+                "shed": c.get("shed", 0),
+            }
         return {
             "requests": c["requests"],
             "latency_avg_s": c["latency_sum"] / c["requests"],
             "latency_max_s": c["latency_max"],
+            "shed": c.get("shed", 0),
         }
 
     # -- collection loop (collector.go:202-221 cadence) ------------------
@@ -132,6 +149,21 @@ class MetricsPlane:
                     sample["prefix_cache"] = {
                         "enabled": engine_stats.get("prefix_cache"),
                         "hit_rate": round(hits / lookups, 3) if lookups else None,
+                    }
+                # deadline/overload rollup: one place answering "is this
+                # agent dropping work, and where" — proxy-side sheds (this
+                # sample's proxy.shed) plus the engine's lifetime policy
+                # counters and its current admission picture
+                if engine_stats.get("cancelled_total") is not None:
+                    sample["deadlines"] = {
+                        "enabled": engine_stats.get("deadlines"),
+                        "proxy_shed": sample["proxy"].get("shed", 0),
+                        "engine_shed_total": engine_stats.get("shed_total", 0),
+                        "cancelled_total": engine_stats.get("cancelled_total", 0),
+                        "expired_total": engine_stats.get("expired_total", 0),
+                        "queue_depth": engine_stats.get("queue_depth", 0),
+                        "waiting_depth": engine_stats.get("waiting_depth", 0),
+                        "draining": engine_stats.get("draining", False),
                     }
             # host-process half of the picture (CPU%/RSS via /proc): on a
             # TPU-VM the host side is what throttles serving

@@ -19,6 +19,7 @@ import asyncio
 import time
 from typing import Awaitable, Callable
 
+from ..core.protocol import DISPATCH_EXPIRED, DISPATCH_IN_FLIGHT
 from ..core.spec import AgentStatus
 from ..manager.agents import AgentManager
 from ..manager.journal import RequestJournal, RequestStatus
@@ -130,9 +131,22 @@ class ReplayWorker:
                     req.headers,
                     req.body,
                     request_id=req.id,
+                    deadline_at=req.deadline_at,
                 )
+                if status == 429:
+                    # engine shed the replay (overload): the entry went back
+                    # to pending — stop hammering this agent until the next
+                    # tick rather than burning the queue into a wall of 429s
+                    break
                 if status >= 0:
                     replayed += 1
+                elif status in (DISPATCH_EXPIRED, DISPATCH_IN_FLIGHT):
+                    # per-entry outcomes (dead-lettered, or another
+                    # dispatcher owns it) — the rest of the queue still
+                    # drains. journal.pending() pre-filters expired entries,
+                    # so DISPATCH_EXPIRED here only catches a deadline
+                    # crossing the list→dispatch gap.
+                    continue
                 else:
                     break  # engine went away mid-drain; next tick retries
         self.replayed_total += replayed

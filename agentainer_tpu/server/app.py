@@ -40,12 +40,20 @@ from ..store.schema import Keys
 if TYPE_CHECKING:
     from ..daemon import Services
 
-REPLAY_HEADER = "X-Agentainer-Replay"
-REQUEST_ID_HEADER = "X-Agentainer-Request-ID"
-
-# dispatch_to_agent sentinel outcomes (never valid HTTP statuses)
-DISPATCH_ENGINE_GONE = -1  # connection refused / engine vanished → stays pending
-DISPATCH_FAILED = -2  # timeout or protocol error → retry accounted
+# wire-protocol constants live in core/protocol.py (shared with the replay
+# worker and engine serve layer); re-exported here for existing importers
+from ..core.protocol import (  # noqa: F401  (re-export)
+    DEADLINE_HEADER,
+    DISPATCH_ENGINE_GONE,
+    DISPATCH_EXPIRED,
+    DISPATCH_FAILED,
+    DISPATCH_IN_FLIGHT,
+    DRAINING_HEADER,
+    EXPIRED_HEADER,
+    LOADING_HEADER,
+    REPLAY_HEADER,
+    REQUEST_ID_HEADER,
+)
 
 _STORE_OPS = {
     "get",
@@ -119,8 +127,12 @@ def ok(data=None, message: str = "", status: int = 200) -> web.Response:
     return web.json_response(envelope(data, message), status=status)
 
 
-def fail(message: str, status: int = 500) -> web.Response:
-    return web.json_response(envelope(None, message, success=False), status=status)
+def fail(
+    message: str, status: int = 500, headers: dict[str, str] | None = None
+) -> web.Response:
+    return web.json_response(
+        envelope(None, message, success=False), status=status, headers=headers
+    )
 
 
 class ControlPlaneApp:
@@ -129,6 +141,10 @@ class ControlPlaneApp:
         self.app = web.Application(middlewares=[self._error_mw, self._auth_mw])
         self._routes()
         self._client: ClientSession | None = None
+        # global pending depth is a store SCAN — cached briefly so the shed
+        # check stays O(1) per proxied request (staleness bound: a burst can
+        # overshoot the global ceiling by ~one cache window of arrivals)
+        self._global_pending_cache: tuple[float, int] = (0.0, 0)
         self.app.on_startup.append(self._on_startup)
         self.app.on_cleanup.append(self._on_cleanup)
 
@@ -199,6 +215,7 @@ class ControlPlaneApp:
         r.add_get("/agents/{agent_id}/logs", self.h_logs)
         r.add_get("/agents/{agent_id}/requests", self.h_requests)
         r.add_post("/agents/{agent_id}/requests/{request_id}/replay", self.h_manual_replay)
+        r.add_post("/agents/{agent_id}/requests/{request_id}/requeue", self.h_requeue)
         r.add_post("/agents/{agent_id}/profile", self.h_profile)
         r.add_get("/agents/{agent_id}/health", self.h_agent_health)
         r.add_get("/agents/{agent_id}/metrics", self.h_agent_metrics)
@@ -390,8 +407,24 @@ class ControlPlaneApp:
         req = self.s.journal.get(agent_id, request_id)
         if req is None:
             return fail("request not found", status=404)
+        if req.expired() or req.status == RequestStatus.EXPIRED:
+            # covers disconnect-expired entries too (dead-lettered with no
+            # deadline set): replaying one would land the same id on both
+            # the expired and completed lists
+            return fail(
+                "request deadline has passed; use requeue to reset and replay",
+                status=410,
+            )
+        # force: a manual replay deliberately re-dispatches settled entries
+        # (the engine's idempotency memo returns the stored result)
         status, _, body = await self.dispatch_to_agent(
-            agent_id, req.method, req.path, req.headers, req.body, request_id=request_id
+            agent_id,
+            req.method,
+            req.path,
+            req.headers,
+            req.body,
+            request_id=request_id,
+            force=True,
         )
         if status == DISPATCH_ENGINE_GONE:
             self._audit(request, "replay", f"{agent_id}/{request_id}", "engine-unreachable")
@@ -404,6 +437,28 @@ class ControlPlaneApp:
             {"request_id": request_id, "status_code": status, "body": body.decode("utf-8", "replace")},
             message="Request replayed",
         )
+
+    async def h_requeue(self, request: web.Request) -> web.Response:
+        """Operator recovery for dead letters: reset a failed/expired entry
+        (retry_count zeroed, deadline cleared) back onto the pending list,
+        then kick the replay worker — transient-outage victims drain without
+        hand-editing the store."""
+        agent_id = request.match_info["agent_id"]
+        request_id = request.match_info["request_id"]
+        self.s.manager.get_agent(agent_id)  # 404 check
+        req = self.s.journal.requeue(agent_id, request_id)
+        if req is None:
+            existing = self.s.journal.get(agent_id, request_id)
+            if existing is None:
+                return fail("request not found", status=404)
+            return fail(
+                f"request is {existing.status}; only failed/expired entries requeue",
+                status=409,
+            )
+        if self.s.replay is not None:
+            self.s.replay.kick()
+        self._audit(request, "requeue", f"{agent_id}/{request_id}", "success")
+        return ok(req.to_dict(), message="Request requeued for replay")
 
     async def h_profile(self, request: web.Request) -> web.Response:
         """Capture a jax.profiler trace on the agent's engine (SURVEY §5.1:
@@ -787,11 +842,46 @@ class ControlPlaneApp:
         headers.pop(REPLAY_HEADER, None)
         headers.pop(REQUEST_ID_HEADER, None)
 
+        # Per-request deadline: an explicit header always sticks; the config
+        # default applies ONLY when the agent is up to serve synchronously.
+        # A request accepted with 202 "queued for replay" keeps the
+        # replay-forever contract unless the caller opted into a deadline —
+        # a silent 30 s default would dead-letter every fire-and-forget
+        # request the moment an outage outlasts it.
+        dl = self.s.config.deadlines
+        deadline_at = None
+        if dl.enabled:
+            raw = request.headers.get(DEADLINE_HEADER, "")
+            ms = 0.0
+            if raw:
+                try:
+                    ms = float(raw)
+                except (TypeError, ValueError):
+                    ms = 0.0
+            elif agent.status == AgentStatus.RUNNING:
+                ms = dl.default_ms
+            if ms > 0:
+                deadline_at = time.time() + ms / 1000.0
+
         request_id = ""
         persist = self.s.config.features.request_persistence
         if persist:
+            if dl.enabled:
+                # overload shedding BEFORE journaling: queueing work beyond
+                # the watermark only manufactures entries that expire
+                # unserved — a fast 429 + Retry-After lets a well-behaved
+                # caller back off while under-watermark traffic still gets
+                # its 202/200
+                reason = self._shed_reason(agent_id, dl)
+                if reason:
+                    self.s.metrics.count_shed(agent_id)
+                    return fail(
+                        f"overloaded: {reason}; retry later",
+                        status=429,
+                        headers={"Retry-After": str(max(1, int(round(dl.retry_after_s))))},
+                    )
             journaled = self.s.journal.store_request(
-                agent_id, request.method, path, headers, body
+                agent_id, request.method, path, headers, body, deadline_at=deadline_at
             )
             request_id = journaled.id
 
@@ -806,9 +896,33 @@ class ControlPlaneApp:
                 )
             return fail("agent is not running", status=503)
 
-        status, resp_headers, resp_body = await self.dispatch_to_agent(
-            agent_id, request.method, path, headers, body, request_id=request_id
+        dispatch = asyncio.ensure_future(
+            self.dispatch_to_agent(
+                agent_id,
+                request.method,
+                path,
+                headers,
+                body,
+                request_id=request_id,
+                deadline_at=deadline_at,
+            )
         )
+        if dl.enabled:
+            # watch the CLIENT while the engine works: a caller that hangs
+            # up mid-dispatch gets its abort propagated — the engine stops
+            # decoding for nobody and the journal entry dead-letters
+            # instead of replaying work with no waiter
+            while True:
+                done, _ = await asyncio.wait({dispatch}, timeout=0.25)
+                if done:
+                    break
+                transport = request.transport
+                if transport is None or transport.is_closing():
+                    dispatch.cancel()
+                    await self._abort_dispatch(agent_id, request_id)
+                    # nobody reads this; it closes the handler cleanly
+                    return web.Response(status=499, reason="Client Closed Request")
+        status, resp_headers, resp_body = await dispatch
         if status == DISPATCH_ENGINE_GONE:
             # connection-level failure: the crash heuristic leaves the request
             # pending for the replay worker (server.go:597-606)
@@ -817,6 +931,18 @@ class ControlPlaneApp:
             # non-crash failure (timeout, protocol error): retry accounting
             # ran; the entry dead-letters after MAX_RETRIES
             return fail("agent request failed; retry recorded", status=504)
+        if status == DISPATCH_EXPIRED:
+            return fail("deadline exceeded; request dead-lettered", status=504)
+        if status == DISPATCH_IN_FLIGHT:
+            # an in-process replay tick CAS-claimed the freshly journaled
+            # entry first (it scans whenever the agent has anything
+            # pending). The work IS running and settles into the journal —
+            # serve the winner's archived result instead of erroring a
+            # live caller on a benign race.
+            archived = await self._await_archived(agent_id, request_id, deadline_at)
+            if archived is not None:
+                return archived
+            return fail("request already being dispatched", status=409)
         out_headers = {
             k: v
             for k, v in resp_headers.items()
@@ -834,6 +960,57 @@ class ControlPlaneApp:
             content_type=(resp_headers.get("Content-Type", "application/octet-stream").split(";")[0]),
         )
 
+    def _shed_reason(self, agent_id: str, dl) -> str:
+        """Why this request should be shed right now, or "" to admit.
+        Three watermarks: per-agent pending depth (O(1) llen), the global
+        pending ceiling, and the engine's own queue+waiting depth from its
+        latest metrics sample (no per-request engine round-trip)."""
+        j = self.s.journal
+        if dl.shed_pending_per_agent and j.pending_depth(agent_id) >= dl.shed_pending_per_agent:
+            # the O(1) llen may be counting entries whose deadline already
+            # passed — a STOPPED agent gets no replay sweep, so an outage
+            # queue full of corpses would shed live replay-forever traffic
+            # for the whole outage. Sweep (pending() dead-letters expired
+            # entries) and recount before deciding; only runs at/over the
+            # watermark, so the hot path stays O(1).
+            if len(j.pending(agent_id)) >= dl.shed_pending_per_agent:
+                return f"agent pending depth >= {dl.shed_pending_per_agent}"
+            self._global_pending_cache = (0.0, 0)  # the sweep moved depths
+        if dl.shed_pending_global:
+            now = time.monotonic()
+            expires, total = self._global_pending_cache
+            if now >= expires:
+                total = j.total_pending()
+                self._global_pending_cache = (now + 0.25, total)
+            if total >= dl.shed_pending_global:
+                return f"global pending depth >= {dl.shed_pending_global}"
+        if dl.engine_queue_watermark:
+            engine = (self.s.metrics.current(agent_id) or {}).get("engine") or {}
+            depth = (engine.get("queue_depth") or 0) + (engine.get("waiting_depth") or 0)
+            if depth >= dl.engine_queue_watermark:
+                return f"engine queue depth {depth} >= {dl.engine_queue_watermark}"
+        return ""
+
+    async def _abort_dispatch(self, agent_id: str, request_id: str) -> None:
+        """Client disconnected mid-dispatch: dead-letter the journal entry
+        (no waiter → replaying it is waste) and tell the engine to stop
+        generating for it. Best effort on both counts."""
+        if request_id:
+            try:
+                self.s.journal.mark_expired(agent_id, request_id, reason="client disconnected")
+            except Exception:
+                pass
+        try:
+            agent = self.s.manager.get_agent(agent_id)
+            endpoint = self.s.manager.endpoint(agent)
+            if endpoint and request_id:
+                await self._cancel_on_engine(endpoint, request_id)
+        except Exception:
+            pass
+        self.s.logs.info(
+            "proxy", f"aborted dispatch {request_id or '<unjournaled>'} for {agent_id}: client disconnected"
+        )
+
     async def dispatch_to_agent(
         self,
         agent_id: str,
@@ -842,14 +1019,21 @@ class ControlPlaneApp:
         headers: dict[str, str],
         body: bytes,
         request_id: str = "",
+        deadline_at: float | None = None,
+        force: bool = False,
     ) -> tuple[int, dict[str, str], bytes]:
         """Forward to the engine and settle the journal entry.
 
         Outcome classification mirrors the reference's interceptTransport
         (server.go:583-615) with the journal entry's lifecycle made explicit:
 
-        - before dispatch the entry flips to PROCESSING so a racing replay
-          pass cannot execute it twice;
+        - before dispatch the entry's pending→processing transition is
+          CLAIMED with a store compare-and-set: of two racing dispatchers
+          (proxy + replay tick) exactly one wins; the loser returns
+          DISPATCH_IN_FLIGHT without forwarding anything. ``force`` skips
+          the claim (manual replay of already-settled entries);
+        - a deadline already passed → mark_expired, DISPATCH_EXPIRED — the
+          engine never sees work nobody is waiting for;
         - success → COMPLETED with the archived response;
         - connection-level failure (engine gone ↔ connection refused) →
           back to PENDING, no retry charged; returns DISPATCH_ENGINE_GONE;
@@ -861,8 +1045,15 @@ class ControlPlaneApp:
         endpoint = self.s.manager.endpoint(agent)
         if endpoint is None:
             return DISPATCH_ENGINE_GONE, {}, b""
+        if deadline_at is not None and time.time() > deadline_at:
+            if request_id:
+                self.s.journal.mark_expired(agent_id, request_id, reason="deadline exceeded")
+            return DISPATCH_EXPIRED, {}, b""
         if request_id:
-            self.s.journal.mark_processing(agent_id, request_id)
+            if force:
+                self.s.journal.mark_processing(agent_id, request_id)
+            elif not self.s.journal.acquire_processing(agent_id, request_id):
+                return DISPATCH_IN_FLIGHT, {}, b""
 
         if endpoint.startswith("fake://"):
             # in-process dispatch for the unit-test backend
@@ -887,14 +1078,32 @@ class ControlPlaneApp:
         url = endpoint.rstrip("/") + path
         fwd_headers = dict(headers)
         fwd_headers.pop("Authorization", None)
+        # the journaled ORIGINAL deadline header must never leak through:
+        # deadline_at is authoritative (a requeued entry has it cleared —
+        # forwarding the stale client value would expire it all over again)
+        fwd_headers.pop(DEADLINE_HEADER, None)
         if request_id:
             fwd_headers[REQUEST_ID_HEADER] = request_id
+        timeout = None  # session default (30 s)
+        if deadline_at is not None:
+            # the engine sees the REMAINING budget, and the dispatch wait is
+            # clamped to it — the old fixed 30 s abandoned the HTTP call
+            # while the engine kept decoding for a caller that was gone
+            remaining = deadline_at - time.time()
+            fwd_headers[DEADLINE_HEADER] = str(max(1, int(remaining * 1000)))
+            from aiohttp import ClientTimeout as _CT
+
+            timeout = _CT(total=min(30.0, max(0.1, remaining)))
         t0 = time.monotonic()
         import aiohttp
 
         try:
             async with self._client.request(
-                method, url, headers=fwd_headers, data=body if body else None
+                method,
+                url,
+                headers=fwd_headers,
+                data=body if body else None,
+                **({"timeout": timeout} if timeout is not None else {}),
             ) as resp:
                 resp_body = await resp.read()
                 resp_headers = dict(resp.headers)
@@ -903,22 +1112,103 @@ class ControlPlaneApp:
                 self.s.journal.mark_pending(agent_id, request_id)
             return DISPATCH_ENGINE_GONE, {}, b""
         except (asyncio.TimeoutError, aiohttp.ClientError, OSError) as e:
+            if deadline_at is not None and time.time() > deadline_at:
+                # the wait ran out the caller's budget: dead-letter and tell
+                # the engine to stop — a retry would also arrive too late
+                if request_id:
+                    self.s.journal.mark_expired(agent_id, request_id, reason="deadline exceeded")
+                    await self._cancel_on_engine(endpoint, request_id)
+                return DISPATCH_EXPIRED, {}, b""
             if request_id:
                 self.s.journal.mark_failed(agent_id, request_id, f"{type(e).__name__}: {e}")
             return DISPATCH_FAILED, {}, b""
-        if resp.status == 503 and resp_headers.get("X-Agentainer-Loading", "").lower() == "true":
-            # engine process is up but its model is still loading: same
-            # journal treatment as engine-gone — stays pending, no retry
-            # charged, the replay worker re-dispatches after load
+        if resp.status == 503 and (
+            resp_headers.get(LOADING_HEADER, "").lower() == "true"
+            or resp_headers.get(DRAINING_HEADER, "").lower() == "true"
+        ):
+            # engine process is up but not admitting (model still loading,
+            # or SIGTERM drain in progress): same journal treatment as
+            # engine-gone — stays pending, no retry charged, the replay
+            # worker re-dispatches once it is back
             if request_id:
                 self.s.journal.mark_pending(agent_id, request_id)
             return DISPATCH_ENGINE_GONE, {}, b""
+        if resp_headers.get(EXPIRED_HEADER, "").lower() == "true":
+            # the engine dropped it by deadline policy: dead-letter, don't
+            # archive a 504 as a completed response
+            if request_id:
+                self.s.journal.mark_expired(agent_id, request_id, reason="expired on engine")
+            return DISPATCH_EXPIRED, {}, b""
+        if resp.status == 429:
+            # engine-side shed: overload is transient — the entry goes back
+            # to pending for a later replay tick (no retry charged; losing
+            # journaled work to a load spike would break the durability
+            # guarantee), while a live caller still sees the 429 +
+            # Retry-After to back off on its own
+            if request_id:
+                self.s.journal.mark_pending(agent_id, request_id)
+            return resp.status, resp_headers, resp_body
         if request_id:
             self.s.journal.store_response(
                 agent_id, request_id, resp.status, resp_headers, resp_body
             )
         self.s.metrics.count_request(agent_id, latency_s=time.monotonic() - t0)
         return resp.status, resp_headers, resp_body
+
+    async def _await_archived(
+        self, agent_id: str, request_id: str, deadline_at: float | None
+    ) -> web.Response | None:
+        """Wait for another dispatcher's settlement of a journal entry and
+        serve its outcome: the archived response for COMPLETED, the matching
+        error for FAILED/EXPIRED. None if it never settles in budget."""
+        import base64 as _b64
+
+        budget = 30.0 if deadline_at is None else max(0.5, deadline_at - time.time())
+        end = time.monotonic() + min(30.0, budget)
+        while time.monotonic() < end:
+            req = self.s.journal.get(agent_id, request_id)
+            if req is None:
+                return None
+            if req.status == RequestStatus.COMPLETED and req.response:
+                r = req.response
+                body = _b64.b64decode(r["body_b64"]) if r.get("body_b64") else b""
+                stored = dict(r.get("headers", {}))
+                out = {
+                    k: v
+                    for k, v in stored.items()
+                    if k.lower() not in _HOP_BY_HOP and k.lower() != "content-type"
+                }
+                out[REQUEST_ID_HEADER] = request_id
+                return web.Response(
+                    status=r.get("status_code", 200),
+                    body=body,
+                    headers=out,
+                    content_type=stored.get(
+                        "Content-Type", "application/octet-stream"
+                    ).split(";")[0],
+                )
+            if req.status == RequestStatus.EXPIRED:
+                return fail("deadline exceeded; request dead-lettered", status=504)
+            if req.status == RequestStatus.FAILED:
+                return fail("agent request failed; retry recorded", status=504)
+            await asyncio.sleep(0.05)
+        return None
+
+    async def _cancel_on_engine(self, endpoint: str, request_id: str) -> None:
+        """Best-effort engine-side abort for a request whose waiter is gone."""
+        if not endpoint.startswith("http"):
+            return
+        try:
+            from aiohttp import ClientTimeout as _CT
+
+            async with self._client.post(
+                endpoint.rstrip("/") + "/cancel",
+                json={"request_id": request_id},
+                timeout=_CT(total=2.0),
+            ) as resp:
+                await resp.read()
+        except Exception:
+            pass
 
 
 def create_app(services: "Services") -> web.Application:

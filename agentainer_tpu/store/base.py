@@ -175,6 +175,37 @@ class Store(ABC):
         ``call_soon_threadsafe`` and must not assume delivery-before-return.
         """
 
+    # -- compare-and-set -------------------------------------------------
+    def cas(
+        self,
+        key: str,
+        expected: bytes | str | None,
+        new: bytes | str,
+        ttl: float | None = None,
+    ) -> bool:
+        """Atomically replace ``key``'s value with ``new`` iff its current
+        value equals ``expected`` (``None`` = key must be absent). Returns
+        whether the swap happened. This is the primitive the request
+        journal's pending→processing transition rides on: two dispatchers
+        (proxy + replay tick) racing the same entry must resolve to exactly
+        one winner, not two dispatches. The default implementation
+        serializes through a per-store lock; subclasses whose backing store
+        has a native CAS should override."""
+        lock = self.__dict__.get("_cas_lock")
+        if lock is None:
+            lock = self.__dict__.setdefault("_cas_lock", threading.Lock())
+        exp = None if expected is None else _to_bytes(expected)
+        with lock:
+            cur = self.get(key)
+            if cur != exp:
+                return False
+            if ttl is None:
+                # preserve the record's remaining TTL across the swap —
+                # a CAS must not silently turn a 24h record permanent
+                ttl = self.ttl(key)
+            self.set(key, new, ttl=ttl)
+            return True
+
     # -- lifecycle -------------------------------------------------------
     @abstractmethod
     def flush(self) -> None: ...

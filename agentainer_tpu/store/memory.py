@@ -110,6 +110,25 @@ class MemoryStore(Store):
             exp = self._expiry.get(key)
             return None if exp is None else max(0.0, exp - time.time())
 
+    def cas(
+        self,
+        key: str,
+        expected: bytes | str | None,
+        new: bytes | str,
+        ttl: float | None = None,
+    ) -> bool:
+        # under the SAME lock every other mutation takes: atomic against
+        # concurrent set/delete, not just against other cas callers
+        with self._lock:
+            cur = self.get(key)
+            exp = None if expected is None else _to_bytes(expected)
+            if cur != exp:
+                return False
+            if ttl is None:
+                ttl = self.ttl(key)
+            self.set(key, new, ttl=ttl)
+            return True
+
     # -- sets ------------------------------------------------------------
     def sadd(self, key: str, *members: str) -> int:
         with self._lock:

@@ -122,6 +122,13 @@ class NativeStore(Store):
         )
         self._cb_threads: list[tuple[threading.Event, threading.Thread]] = []
         self._closed = False
+        # CAS serialization: the C++ store has no native compare-and-set
+        # opcode, so cas() brackets get+set under this lock. That is atomic
+        # for every Python-side caller of cas() on this handle — the journal
+        # processing transition, its only user — but NOT against raw native
+        # writes from the C++ data plane (which never touches journal
+        # status fields).
+        self._cas_lock = threading.Lock()
         # in-flight native-call accounting: close() must not free the C++
         # store while any thread is inside a lib call on this handle
         self._inflight = 0
@@ -193,6 +200,22 @@ class NativeStore(Store):
     def ttl(self, key: str) -> float | None:
         status, vals = self._cmd(OP_TTL, key)
         return None if status == RESP_NIL else float(vals[0])
+
+    def cas(
+        self,
+        key: str,
+        expected: bytes | str | None,
+        new: bytes | str,
+        ttl: float | None = None,
+    ) -> bool:
+        exp = None if expected is None else _to_bytes(expected)
+        with self._cas_lock:
+            if self.get(key) != exp:
+                return False
+            if ttl is None:
+                ttl = self.ttl(key)
+            self.set(key, new, ttl=ttl)
+            return True
 
     # -- sets -------------------------------------------------------------
     def sadd(self, key: str, *members: str) -> int:

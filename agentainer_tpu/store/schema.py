@@ -61,6 +61,14 @@ class Keys:
         return f"agent:{agent_id}:requests:failed"
 
     @staticmethod
+    def expired(agent_id: str) -> str:
+        """Dead-letter list for requests whose deadline passed before they
+        could be served — work nobody is waiting for anymore. Distinct from
+        ``failed`` (which implies the engine tried and errored) so operators
+        can requeue outage victims without replaying genuinely bad requests."""
+        return f"agent:{agent_id}:requests:expired"
+
+    @staticmethod
     def health(agent_id: str) -> str:
         return f"health:{agent_id}"
 

@@ -24,9 +24,25 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import faulthandler  # noqa: E402
 import socket  # noqa: E402
 
 import pytest  # noqa: E402
+
+# A hung worker-loop test must print stacks, not silently eat the tier-1
+# budget: faulthandler dumps EVERY thread's traceback (worker thread,
+# readback waits, asyncio loop) to stderr if a single test exceeds the
+# window, then the run continues — the dump is diagnosis, not a killer
+# (timeout -k on the whole suite remains the hard stop).
+faulthandler.enable()
+_TEST_DUMP_S = float(os.environ.get("ATPU_TEST_DUMP_S", "300"))
+
+
+@pytest.fixture(autouse=True)
+def _dump_stacks_on_hang():
+    faulthandler.dump_traceback_later(_TEST_DUMP_S, exit=False)
+    yield
+    faulthandler.cancel_dump_traceback_later()
 
 
 def _native_available() -> bool:

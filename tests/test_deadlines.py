@@ -1,0 +1,440 @@
+"""End-to-end deadline / cancellation / overload-shedding tests (ISSUE 3).
+
+Engine level: an expired queued request fails BEFORE prefill; cancelling an
+in-flight generation parks its lane and frees the slot for a waiting
+request; the submit-side watermark sheds with EngineOverloaded while
+under-watermark work still completes; SIGTERM drain stops admission and
+finishes in-flight lanes. Control-plane level: the proxy sheds 429 +
+Retry-After past the pending watermark while under-watermark traffic still
+gets its 202, journals the deadline, and the replay worker dead-letters
+expired entries instead of replaying work nobody is waiting for. Journal
+level: the pending→processing CAS admits exactly one dispatcher; requeue
+resets dead letters back onto pending.
+"""
+
+import asyncio
+import time
+from types import SimpleNamespace
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from agentainer_tpu.config import Config
+from agentainer_tpu.daemon import build_services
+from agentainer_tpu.engine.llm import (
+    EngineDraining,
+    EngineOverloaded,
+    LLMEngine,
+    RequestCancelled,
+    RequestExpired,
+)
+from agentainer_tpu.manager.journal import RequestStatus
+from agentainer_tpu.runtime.backend import FakeBackend
+from agentainer_tpu.store import MemoryStore
+
+TOKEN = "deadline-token"
+AUTH = {"Authorization": f"Bearer {TOKEN}"}
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_engine(**opts) -> LLMEngine:
+    o = dict(max_batch=1, max_seq=512, decode_chunk=4, prefill_chunk=32)
+    o.update(opts)
+    return LLMEngine.create("tiny", options=o)
+
+
+async def _wait_admitted(eng: LLMEngine, min_prefills: int = 1) -> None:
+    for _ in range(500):
+        if eng.prefills >= min_prefills:
+            return
+        await asyncio.sleep(0.01)
+    raise AssertionError("background generation never admitted")
+
+
+# -- engine level ---------------------------------------------------------
+def test_expired_queued_request_fails_before_prefill():
+    async def body():
+        eng = make_engine()
+        try:
+            with pytest.raises(RequestExpired):
+                await eng.generate(
+                    "already too late", max_tokens=4, deadline_at=time.time() - 1.0
+                )
+            assert eng.expired_total == 1
+            assert eng.prefills == 0  # fail-fast cost ZERO device work
+
+            # queued-behind-a-busy-slot variant: the deadline passes while
+            # waiting for admission; still no prefill for the expired one
+            a = asyncio.ensure_future(
+                eng.generate("occupy the only slot", max_tokens=300, temperature=0.0)
+            )
+            await _wait_admitted(eng)
+            before = eng.prefills
+            with pytest.raises(RequestExpired):
+                await eng.generate(
+                    "expires in queue", max_tokens=4, deadline_at=time.time() + 0.05
+                )
+            assert eng.prefills == before
+            assert eng.expired_total == 2
+            r = await a
+            assert r["completion_tokens"] > 0
+        finally:
+            eng.shutdown()
+
+    run(body())
+
+
+def test_cancel_inflight_frees_slot_for_waiting_request():
+    async def body():
+        eng = make_engine()  # max_batch=1: B can only run if A's slot frees
+        try:
+            a = asyncio.ensure_future(
+                eng.generate(
+                    "a very long generation to cancel",
+                    max_tokens=400,
+                    temperature=0.0,
+                    request_id="gen-cancel-a",
+                )
+            )
+            await _wait_admitted(eng)
+            b = asyncio.ensure_future(eng.generate("waiting for the slot", max_tokens=4))
+            await asyncio.sleep(0.05)
+            assert not b.done()
+
+            assert eng.cancel("gen-cancel-a") is True
+            with pytest.raises(RequestCancelled):
+                await asyncio.wait_for(a, 30)
+            rb = await asyncio.wait_for(b, 30)
+            assert rb["completion_tokens"] >= 1
+            assert eng.cancelled_total == 1
+            m = eng.metrics()
+            assert m["cancelled_total"] == 1
+            assert m["active_requests"] == 0
+        finally:
+            eng.shutdown()
+
+    run(body())
+
+
+def test_engine_shed_watermark_overload():
+    async def body():
+        eng = make_engine(shed_watermark=2)
+        try:
+            a = asyncio.ensure_future(
+                eng.generate("lane occupant", max_tokens=300, temperature=0.0)
+            )
+            await _wait_admitted(eng)
+            b = asyncio.ensure_future(eng.generate("queued under watermark", max_tokens=2))
+            await asyncio.sleep(0.05)
+            with pytest.raises(EngineOverloaded) as ei:
+                await eng.generate("over the watermark", max_tokens=2)
+            assert ei.value.retry_after_s >= 1.0
+            assert eng.shed_total == 1
+            # under-watermark traffic still completes
+            ra, rb = await asyncio.gather(a, b)
+            assert ra["completion_tokens"] > 0
+            assert rb["completion_tokens"] >= 1
+        finally:
+            eng.shutdown()
+
+    run(body())
+
+
+def test_drain_stops_admission_and_finishes_inflight():
+    async def body():
+        eng = make_engine()
+        try:
+            a = asyncio.ensure_future(
+                eng.generate("inflight through the drain", max_tokens=100, temperature=0.0)
+            )
+            await _wait_admitted(eng)
+            eng.begin_drain()
+            with pytest.raises(EngineDraining):
+                await eng.generate("late arrival", max_tokens=2)
+            clean = await asyncio.to_thread(eng.drain, 60.0)
+            assert clean is True
+            ra = await a
+            assert ra["completion_tokens"] > 0
+            assert eng.metrics()["draining"] is True
+        finally:
+            eng.shutdown()
+
+    run(body())
+
+
+def test_graceful_drain_snapshots_sessions():
+    """Serve-layer half of the SIGTERM story: drain, then a final
+    durability snapshot of every resident session with the limiter lifted."""
+    from agentainer_tpu.engine.llm_serve import LLMServeApp
+
+    app = LLMServeApp(env={"AGENTAINER_AGENT_ID": "t-drain"})
+
+    class _StubEngine:
+        def __init__(self):
+            self.sessions = {"t-drain::s1": 0, "other-agent::sX": 1}
+            self.snapshot_min_gap_s = 2.0
+            self.snapshot_busy_gap_s = 10.0
+
+        def drain(self, budget_s):
+            self.drained_with = budget_s
+            return True
+
+        async def snapshot_session(self, name):
+            return b"kv-blob:" + name.encode()
+
+    stub = _StubEngine()
+    app.engine = stub
+    written = {}
+
+    async def set_bytes(key, blob, ttl=None):
+        written[key] = blob
+
+    app.store = SimpleNamespace(connected=True, set_bytes=set_bytes)
+    run(app._graceful_drain())
+    assert app.draining and app.drained_clean is True
+    assert stub.drained_with == app.drain_budget_s
+    assert stub.snapshot_min_gap_s == 0.0  # limiter lifted post-drain
+    # only THIS agent's sessions are snapshotted, under its kvcache key
+    assert list(written) == ["agent:t-drain:kvcache:s1"]
+    assert app.drain_snapshots == 1
+
+
+# -- control plane --------------------------------------------------------
+def make_services(tmp_path, **deadline_overrides):
+    cfg = Config()
+    cfg.auth_token = TOKEN
+    for k, v in deadline_overrides.items():
+        setattr(cfg.deadlines, k, v)
+    return build_services(
+        config=cfg,
+        store=MemoryStore(),
+        backend=FakeBackend(),
+        console_logs=False,
+        data_dir=str(tmp_path),
+    )
+
+
+async def client_for(services) -> TestClient:
+    client = TestClient(TestServer(services.app))
+    await client.start_server()
+    return client
+
+
+async def deploy(client, name="a", start=True):
+    resp = await client.post("/agents", json={"name": name, "model": "echo"}, headers=AUTH)
+    agent = (await resp.json())["data"]
+    if start:
+        resp = await client.post(f"/agents/{agent['id']}/start", headers=AUTH)
+        assert resp.status == 200
+    return agent
+
+
+def test_proxy_sheds_429_past_pending_watermark(tmp_path):
+    async def body():
+        services = make_services(tmp_path, shed_pending_per_agent=2)
+        client = await client_for(services)
+        try:
+            agent = await deploy(client, start=False)  # not running → 202 path
+            for _ in range(2):  # under the watermark: still queued
+                resp = await client.post(f"/agent/{agent['id']}/chat", data=b"{}")
+                assert resp.status == 202
+            resp = await client.post(f"/agent/{agent['id']}/chat", data=b"{}")
+            assert resp.status == 429
+            assert resp.headers.get("Retry-After") == "1"
+            doc = await resp.json()
+            assert doc["success"] is False and "overloaded" in doc["message"]
+            # nothing journaled for the shed request
+            assert services.journal.stats(agent["id"])["pending"] == 2
+            with services.metrics._lock:
+                assert services.metrics._counters[agent["id"]]["shed"] == 1
+        finally:
+            await client.close()
+
+    run(body())
+
+
+def test_proxy_journals_deadline_and_serves_under_watermark(tmp_path):
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        try:
+            agent = await deploy(client)
+            t0 = time.time()
+            resp = await client.post(
+                f"/agent/{agent['id']}/chat",
+                data=b'{"message":"hi"}',
+                headers={"X-Agentainer-Deadline-Ms": "5000"},
+            )
+            assert resp.status == 200
+            rid = resp.headers["X-Agentainer-Request-ID"]
+            req = services.journal.get(agent["id"], rid)
+            assert req.status == RequestStatus.COMPLETED
+            assert req.deadline_at is not None
+            assert t0 + 4.0 < req.deadline_at < t0 + 6.0
+        finally:
+            await client.close()
+
+    run(body())
+
+
+def test_replay_skips_expired_entries(tmp_path):
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        try:
+            agent = await deploy(client)
+            aid = agent["id"]
+            live = services.journal.store_request(
+                aid, "POST", "/chat", {}, b'{"message":"live"}'
+            )
+            stale = services.journal.store_request(
+                aid,
+                "POST",
+                "/chat",
+                {},
+                b'{"message":"stale"}',
+                deadline_at=time.time() - 5.0,
+            )
+            replayed = await services.replay.scan_once()
+            assert replayed == 1
+            stats = services.journal.stats(aid)
+            assert stats["pending"] == 0
+            assert stats["expired"] == 1
+            assert services.journal.get(aid, live.id).status == RequestStatus.COMPLETED
+            dead = services.journal.get(aid, stale.id)
+            assert dead.status == RequestStatus.EXPIRED
+            assert [r.id for r in services.journal.by_status(aid, "expired")] == [stale.id]
+        finally:
+            await client.close()
+
+    run(body())
+
+
+def test_requeue_recovers_dead_letters(tmp_path):
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        try:
+            agent = await deploy(client)
+            aid = agent["id"]
+            req = services.journal.store_request(
+                aid, "POST", "/chat", {}, b'{"message":"dead"}'
+            )
+            for i in range(3):  # dead-letter it
+                services.journal.mark_failed(aid, req.id, f"boom-{i}")
+            assert services.journal.get(aid, req.id).status == RequestStatus.FAILED
+
+            resp = await client.post(
+                f"/agents/{aid}/requests/{req.id}/requeue", headers=AUTH
+            )
+            assert resp.status == 200, await resp.text()
+            back = services.journal.get(aid, req.id)
+            assert back.status == RequestStatus.PENDING
+            assert back.retry_count == 0
+            assert services.journal.pending_ids(aid) == [req.id]
+
+            # requeue of a settled entry is refused
+            assert await services.replay.scan_once() == 1
+            resp = await client.post(
+                f"/agents/{aid}/requests/{req.id}/requeue", headers=AUTH
+            )
+            assert resp.status == 409
+        finally:
+            await client.close()
+
+    run(body())
+
+
+def test_abort_dispatch_dead_letters_entry(tmp_path):
+    """Client-disconnect propagation: the proxy's abort path dead-letters
+    the journal entry so replay never re-executes work with no waiter."""
+
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        try:
+            agent = await deploy(client)
+            aid = agent["id"]
+            req = services.journal.store_request(aid, "POST", "/chat", {}, b"{}")
+            app_obj = services.dispatch.__self__
+            await app_obj._abort_dispatch(aid, req.id)
+            dead = services.journal.get(aid, req.id)
+            assert dead.status == RequestStatus.EXPIRED
+            assert dead.error == "client disconnected"
+            assert services.journal.pending_ids(aid) == []
+        finally:
+            await client.close()
+
+    run(body())
+
+
+def test_shed_sweeps_dead_entries_before_refusing(tmp_path):
+    """A stopped agent's queue full of already-expired entries must not
+    shed live replay-forever traffic: the watermark trip sweeps the dead
+    letters and recounts before answering 429."""
+
+    async def body():
+        services = make_services(tmp_path, shed_pending_per_agent=2)
+        client = await client_for(services)
+        try:
+            agent = await deploy(client, start=False)
+            for _ in range(2):
+                resp = await client.post(
+                    f"/agent/{agent['id']}/chat",
+                    data=b"{}",
+                    headers={"X-Agentainer-Deadline-Ms": "50"},
+                )
+                assert resp.status == 202
+            await asyncio.sleep(0.1)  # both queued entries are now corpses
+            resp = await client.post(f"/agent/{agent['id']}/chat", data=b"{}")
+            assert resp.status == 202  # swept, not shed
+            stats = services.journal.stats(agent["id"])
+            assert stats["expired"] == 2
+            assert stats["pending"] == 1
+        finally:
+            await client.close()
+
+    run(body())
+
+
+def test_requeue_single_winner():
+    """Concurrent requeues of the same dead letter must not double-push the
+    id onto the pending list (the CAS admits exactly one winner)."""
+    from agentainer_tpu.manager.journal import RequestJournal
+
+    store = MemoryStore()
+    j = RequestJournal(store)
+    req = j.store_request("a1", "POST", "/chat", body=b"x")
+    for i in range(3):
+        j.mark_failed("a1", req.id, f"boom-{i}")
+    assert j.requeue("a1", req.id) is not None
+    assert j.requeue("a1", req.id) is None  # already PENDING: loser backs off
+    assert j.pending_ids("a1") == [req.id]
+
+
+# -- journal CAS ----------------------------------------------------------
+def test_acquire_processing_single_winner():
+    store = MemoryStore()
+    from agentainer_tpu.manager.journal import RequestJournal
+
+    j = RequestJournal(store)
+    req = j.store_request("a1", "POST", "/chat", body=b"x")
+    assert j.acquire_processing("a1", req.id) is True
+    # second claimant loses: the entry is already PROCESSING
+    assert j.acquire_processing("a1", req.id) is False
+    j.mark_pending("a1", req.id)
+    assert j.acquire_processing("a1", req.id) is True
+
+
+def test_store_cas_semantics(store):
+    store.set("k", b"v1")
+    assert store.cas("k", b"v1", b"v2") is True
+    assert store.get("k") == b"v2"
+    assert store.cas("k", b"v1", b"v3") is False  # stale expected
+    assert store.get("k") == b"v2"
+    assert store.cas("missing", None, b"first") is True
+    assert store.get("missing") == b"first"
+    assert store.cas("missing", None, b"second") is False

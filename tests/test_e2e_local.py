@@ -125,6 +125,7 @@ def test_crash_replay_with_real_processes(tmp_path):
                 "pending": 0,
                 "completed": 3,
                 "failed": 0,
+                "expired": 0,
             }
 
             # conversation survived the crash AND the replayed turns landed
@@ -133,6 +134,68 @@ def test_crash_replay_with_real_processes(tmp_path):
             assert "before crash" in contents
             assert "during crash" in contents
             assert "still down" in contents
+        finally:
+            await teardown(services, client)
+
+    run(body())
+
+
+def test_crash_replay_skips_expired_requests(tmp_path):
+    """Crash × deadline interaction: SIGKILL an engine with a mix of live
+    and short-deadline journaled requests; after resume, replay executes
+    only the live ones and the expired ones land on the ``expired``
+    dead-letter list — a restart must not burn engine time on answers
+    nobody is waiting for."""
+
+    async def body():
+        services, client = await start_stack(tmp_path)
+        try:
+            resp = await client.post(
+                "/agents", json={"name": "echo-dl", "model": "echo"}, headers=AUTH
+            )
+            agent = (await resp.json())["data"]
+            await client.post(f"/agents/{agent['id']}/start", headers=AUTH)
+
+            # real SIGKILL, then queue work while the agent is down
+            engine_id = services.manager.get_agent(agent["id"]).engine_id
+            services.backend.kill_engine_hard(engine_id)
+            services.quick_sync.sync_agent(agent["id"])
+            assert services.manager.get_agent(agent["id"]).status.value == "stopped"
+
+            resp = await client.post(
+                f"/agent/{agent['id']}/chat",
+                data=json.dumps({"message": "doomed"}),
+                headers={"X-Agentainer-Deadline-Ms": "150"},
+            )
+            assert resp.status == 202
+            doomed_id = (await resp.json())["data"]["request_id"]
+            resp = await client.post(
+                f"/agent/{agent['id']}/chat", data=json.dumps({"message": "survivor"})
+            )
+            assert resp.status == 202
+            survivor_id = (await resp.json())["data"]["request_id"]
+            assert services.journal.stats(agent["id"])["pending"] == 2
+
+            await asyncio.sleep(0.3)  # the 150 ms deadline passes
+
+            resp = await client.post(f"/agents/{agent['id']}/resume", headers=AUTH)
+            assert resp.status == 200, await resp.text()
+            replayed = await services.replay.scan_once()
+            assert replayed == 1
+            stats = services.journal.stats(agent["id"])
+            assert stats["pending"] == 0
+            assert stats["expired"] == 1
+            assert stats["completed"] == 1
+            assert services.journal.get(agent["id"], doomed_id).status == "expired"
+            assert services.journal.get(agent["id"], survivor_id).status == "completed"
+            expired = services.journal.by_status(agent["id"], "expired")
+            assert [r.id for r in expired] == [doomed_id]
+
+            # only the survivor's turn reached the engine
+            resp = await client.get(f"/agent/{agent['id']}/history")
+            contents = [t["content"] for t in (await resp.json())["history"]]
+            assert "survivor" in contents
+            assert "doomed" not in contents
         finally:
             await teardown(services, client)
 

@@ -22,7 +22,7 @@ def test_store_and_complete():
 
     j.store_response("a1", req.id, 200, {"Content-Type": "application/json"}, b"ok")
     assert j.pending_ids("a1") == []
-    assert j.stats("a1") == {"pending": 0, "completed": 1, "failed": 0}
+    assert j.stats("a1") == {"pending": 0, "completed": 1, "failed": 0, "expired": 0}
     done = j.get("a1", req.id)
     assert done.status == RequestStatus.COMPLETED
     assert done.response["status_code"] == 200

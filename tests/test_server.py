@@ -149,7 +149,7 @@ def test_proxy_forwards_and_journals(tmp_path):
 
         # journaled and completed
         stats = services.journal.stats(agent["id"])
-        assert stats == {"pending": 0, "completed": 1, "failed": 0}
+        assert stats == {"pending": 0, "completed": 1, "failed": 0, "expired": 0}
         resp = await client.get(
             f"/agents/{agent['id']}/requests", params={"status": "completed"}, headers=AUTH
         )
@@ -225,6 +225,7 @@ def test_crash_leaves_pending_then_replay_drains(tmp_path):
             "pending": 0,
             "completed": 2,
             "failed": 0,
+            "expired": 0,
         }
         await client.close()
 

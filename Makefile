@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all native test t1 test-native test-kernels bench overload server dryrun verify clean
+.PHONY: all native test t1 test-native test-kernels bench overload spec server dryrun verify clean
 
 all: native
 
@@ -34,6 +34,12 @@ bench: native
 # with shedding on vs off at 2x saturation; full run drops ATPU_OVERLOAD_SMOKE
 overload:
 	JAX_PLATFORMS=cpu ATPU_OVERLOAD_SMOKE=1 $(PY) scripts/bench_overload.py
+
+# speculative-decoding A/B in smoke mode (short passes, tiny model): steady
+# decode ITL spec on vs off across json/chat/adversarial workloads; full
+# run drops ATPU_SPEC_SMOKE
+spec:
+	JAX_PLATFORMS=cpu ATPU_SPEC_SMOKE=1 $(PY) scripts/bench_spec.py
 
 server: native
 	$(PY) -m agentainer_tpu.cli server

@@ -116,6 +116,13 @@ def cmd_deploy(args) -> None:
             print(f"  {line}")
         print(f"built artifact {art['name']!r}")
         model = {"engine": "llm", "artifact": art["name"]}
+    if getattr(args, "no_speculative", False):
+        # A/B baseline deploy: pin this agent's engine to the plain decode
+        # path (options.speculative=false, same channel the deploy YAML uses)
+        if isinstance(model, str):
+            engine, _, config = model.partition(":")
+            model = {"engine": engine or "echo", "config": config}
+        model.setdefault("options", {})["speculative"] = False
     body = {
         "name": args.name,
         "model": model,
@@ -352,6 +359,13 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--chips", type=int, default=1)
     s.add_argument("--hbm-bytes", type=int, default=8 * 1024**3)
     s.add_argument("--auto-restart", action="store_true")
+    s.add_argument(
+        "--no-speculative",
+        action="store_true",
+        help="disable self-speculative decoding for this agent's engine "
+        "(the plain-decode A/B baseline; same as options.speculative: false "
+        "in a deployment YAML)",
+    )
     s.add_argument("--health-endpoint", default="")
     s.add_argument("--health-interval", type=float, default=30.0)
     s.add_argument("--health-timeout", type=float, default=5.0)

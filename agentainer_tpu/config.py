@@ -40,6 +40,10 @@ class FeatureFlags:
     # Serve /agent/* + the engine store socket from the C++ data plane when
     # the native library is available (falls back to the aiohttp proxy).
     native_dataplane: bool = True
+    # Default for engines' self-speculative decoding (prompt-lookup drafts
+    # + batched verify). Per-deployment model options override; false here
+    # pins the whole fleet to the plain decode path (the A/B baseline).
+    speculative: bool = True
 
 
 @dataclass
@@ -171,6 +175,15 @@ def load_config(path: str | None = None) -> Config:
     )
     if "ATPU_NATIVE_DATAPLANE" in env:
         cfg.features.native_dataplane = env["ATPU_NATIVE_DATAPLANE"].lower() in (
+            "1",
+            "true",
+            "yes",
+        )
+    cfg.features.speculative = bool(
+        feats.get("speculative", cfg.features.speculative)
+    )
+    if "ATPU_SPECULATIVE" in env:
+        cfg.features.speculative = env["ATPU_SPECULATIVE"].lower() in (
             "1",
             "true",
             "yes",

@@ -10,6 +10,7 @@ health per-agent), then serve until stopped.
 from __future__ import annotations
 
 import asyncio
+import os
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable
 
@@ -60,6 +61,15 @@ def build_services(
     data_dir: str | None = None,
 ) -> Services:
     config = config or load_config()
+    # engines inherit the daemon's environment (runtime/local.py builds
+    # their env from os.environ): exporting the speculative-decoding
+    # default here is what lets `features.speculative: false` in
+    # config.yaml pin every spawned engine to the plain-decode baseline
+    # without touching each deployment's model options. Written BOTH ways:
+    # load_config already folded any operator-set ATPU_SPECULATIVE into
+    # the flag, so this is a write-back of the resolved value — a second
+    # build_services with a different config must not inherit a stale latch
+    os.environ["ATPU_SPECULATIVE"] = "1" if config.features.speculative else "0"
     ddir = data_dir if data_dir is not None else config.data_path
     if store is None:
         url = config.store_url

@@ -41,10 +41,31 @@ from jax import lax
 
 from ..models.configs import ModelConfig, get_config
 from ..models.llama import KVCache, forward, init_params
-from .sampling import sample
+from .sampling import NEG_INF, sample
 from .tokenizer import load_tokenizer
 
 PREFILL_BUCKETS = (32, 64, 128, 256, 512, 1024)
+
+# Self-speculative decoding (prompt-lookup drafting + batched multi-token
+# verification). The verify ladder mirrors the decode-chunk ladder: one
+# compiled k-token verify program per bucket, warmed at startup, the round's
+# bucket chosen as the smallest covering the longest draft in the batch.
+SPEC_VERIFY_BUCKETS = (2, 4, 8)
+# acceptance-rate EMA: fast-collapsing (a handful of all-rejected rounds
+# sends gamma to 0) so adversarial/low-match traffic degrades to the plain
+# decode ladder instead of paying verify forwards that never accept
+SPEC_EMA_ALPHA = 0.4
+SPEC_EMA_FLOOR = 0.125
+# consecutive draft-lookup misses before a lane stops triggering the
+# (pipeline-draining) speculation path; collapsed/missing lanes re-probe
+# every SPEC_PROBE_EVERY decode steps so a workload shift is noticed
+SPEC_MISS_BACKOFF = 4
+SPEC_PROBE_EVERY = 32
+# the drafter's reverse n-gram scan is pure Python on the worker thread,
+# serialized inside the (synchronous) verify round: cap how far back it
+# looks so a 4096-token context can't turn every lookup miss into
+# milliseconds of host stall on the decode critical path
+SPEC_LOOKUP_WINDOW = 1024
 
 
 class SnapshotDeferred(Exception):
@@ -205,6 +226,14 @@ class Slot:
     # decoding = this slot's lane in the device carry is live (its first
     # token was injected and decode chunks are advancing it)
     decoding: bool = False
+    # self-speculation state: the token stream fed through this slot's KV
+    # across the session's turns (the drafter's lookup corpus), the
+    # acceptance-rate EMA driving per-lane draft length, and lookup-miss /
+    # probe bookkeeping bounding speculation's cost on low-match traffic
+    spec_hist: list[int] = field(default_factory=list)
+    spec_ema: float = 1.0
+    spec_miss: int = 0
+    spec_probe_at: int = -(10**9)
 
 
 class LLMEngine:
@@ -230,6 +259,8 @@ class LLMEngine:
         prefix_cache_bytes: int = 0,
         deadlines: bool = True,
         shed_watermark: int = 0,
+        speculative: bool = True,
+        spec_gamma_max: int = 8,
     ):
         self.cfg = cfg
         self.tokenizer = tokenizer
@@ -529,6 +560,29 @@ class LLMEngine:
         self.prefix_eviction_idle_s_recent: collections.deque[float] = (
             collections.deque(maxlen=64)
         )
+        # Self-speculative decoding (prompt-lookup drafting + batched
+        # multi-token verification): a host-side drafter matches each
+        # slot's trailing n-gram against its own token stream and proposes
+        # up to gamma continuation tokens; one compiled verify forward per
+        # round scores every lane's drafts in parallel and accepts the
+        # longest agreeing prefix. speculative=False is the A/B baseline
+        # (mirrors adaptive_decode / prefix_cache).
+        self.speculative = bool(speculative)
+        gamma_max = max(1, min(int(spec_gamma_max), SPEC_VERIFY_BUCKETS[-1]))
+        self._spec_buckets = [
+            b for b in SPEC_VERIFY_BUCKETS if b <= gamma_max
+        ] or [SPEC_VERIFY_BUCKETS[0]]
+        # snap DOWN to the largest compiled bucket: a gamma between buckets
+        # (e.g. 5 with ladder {2,4}) would draft longer than any verify
+        # program covers and the round's bucket pick would fail
+        self.spec_gamma_max = self._spec_buckets[-1]
+        self._verify_fns: dict[int, Any] = {}
+        self._spec_active = self.speculative  # warmup serves with it off
+        self.spec_rounds = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.spec_rejected = 0
+        self.spec_verify_hist: dict[int, int] = {}
         self._n_chips = self.tp * self.ep * self.sp * self.pp
         self._chip = chip_spec((devices or jax.devices() or [None])[0])
         self._peak_flops = self._chip.bf16_flops * self._n_chips
@@ -649,6 +703,8 @@ class LLMEngine:
                 prefix_cache_bytes=int(options.get("prefix_cache_bytes", 0) or 0),
                 deadlines=bool(options.get("deadlines", True)),
                 shed_watermark=int(options.get("shed_watermark", 0) or 0),
+                speculative=bool(options.get("speculative", True)),
+                spec_gamma_max=int(options.get("spec_gamma_max", 8) or 8),
             )
             if not options.get("skip_warmup"):
                 engine.warmup()
@@ -772,6 +828,8 @@ class LLMEngine:
             prefix_cache_bytes=int(options.get("prefix_cache_bytes", 0) or 0),
             deadlines=bool(options.get("deadlines", True)),
             shed_watermark=int(options.get("shed_watermark", 0) or 0),
+            speculative=bool(options.get("speculative", True)),
+            spec_gamma_max=int(options.get("spec_gamma_max", 8) or 8),
         )
         # pay the decode/prefill compiles here (inside the loader thread, while
         # /health keeps answering) instead of on the first user request.
@@ -885,6 +943,10 @@ class LLMEngine:
         self._prefill = jax.jit(prefill, donate_argnums=(1,))
         self._decode_n = jax.jit(decode_n, donate_argnums=(1, 2, 3))
         self._inject = jax.jit(inject, donate_argnums=(0, 1, 2))
+        # the verify ladder reuses the same forward (one prefill-shaped call
+        # with t = k+1 per round); fns are built per bucket on demand and
+        # warmed alongside the decode ladder
+        self._run_forward = run_forward
 
     def warmup(self) -> None:
         """Pre-compile every serve-path signature BY SERVING: one synthetic
@@ -956,13 +1018,18 @@ class LLMEngine:
         # filler-token prefix, and a prefix hit would shrink a pass's tail
         # below its bucket — exactly the prefill signature warmup exists to
         # compile. The fork/slice fns are warmed explicitly below instead.
+        # Speculation is OFF too: the filler prompts are maximally
+        # repetitive, and a spec round replacing a decode chunk would leave
+        # ladder buckets uncompiled. The verify ladder is warmed explicitly.
         self._prefix_active = False
+        self._spec_active = False
         try:
             t = threading.Thread(target=_runner, name="llm-warmup")
             t.start()
             t.join()
         finally:
             self._prefix_active = self.prefix_cache
+            self._spec_active = self.speculative
         if box:
             raise box[0]
         # pre-compile the snapshot slicers too: their first jit used to
@@ -988,6 +1055,25 @@ class LLMEngine:
                 k, v = self._prefix_slice_fn(b)(self.cache, jnp.int32(0))
                 self.cache = self._prefix_fork_fn(b)(
                     self.cache, jnp.int32(0), k, v
+                )
+            jax.block_until_ready(self.cache.k)
+        # verify ladder (speculative decoding): one compiled k-token verify
+        # program per bucket, exercised against the live carry/cache — all
+        # lanes are parked at scratch here, so the round's writes land in
+        # the scratch rows exactly like plain parked decode. A serving-time
+        # spec round must never pay a compile.
+        if self.speculative:
+            for b in self._spec_buckets:
+                self._rng, key = jax.random.split(self._rng)
+                _, _, self._dtok, self._dpos, self.cache = self._verify_fn(b)(
+                    self.params,
+                    self.cache,
+                    self._dtok,
+                    self._dpos,
+                    self._dtemps,
+                    jnp.zeros((self.max_batch, b), jnp.int32),
+                    jnp.zeros((self.max_batch,), jnp.int32),
+                    key,
                 )
             jax.block_until_ready(self.cache.k)
         # warmup traffic is not serving telemetry: TTFT samples here include
@@ -1438,6 +1524,25 @@ class LLMEngine:
                 str(k): v for k, v in sorted(self.decode_chunk_hist.copy().items())
             },
             "decode_chunks_shrunk": self.decode_chunks_shrunk,
+            # self-speculative decoding: drafted/accepted/rejected token
+            # counters, verify-bucket histogram (.copy() for the same
+            # mid-scrape reason as decode_chunk_hist), and each slot's live
+            # acceptance EMA — a collapsed gamma shows up as EMAs pinned
+            # under the floor while spec_rounds stops advancing
+            "speculative": self.speculative,
+            "spec_rounds": self.spec_rounds,
+            "spec_drafted": self.spec_drafted,
+            "spec_accepted": self.spec_accepted,
+            "spec_rejected": self.spec_rejected,
+            "spec_acceptance_rate": (
+                round(self.spec_accepted / self.spec_drafted, 4)
+                if self.spec_drafted
+                else None
+            ),
+            "spec_verify_hist": {
+                str(k): v for k, v in sorted(self.spec_verify_hist.copy().items())
+            },
+            "spec_slot_acceptance": [round(s.spec_ema, 3) for s in self.slots],
             "worker_errors": self.worker_errors,
             "last_worker_error": self.last_worker_error or None,
             "cache_resets": self.cache_resets,
@@ -1613,7 +1718,12 @@ class LLMEngine:
                 self._prefilling_slot = None
             try:
                 if any(s.decoding for s in self.slots):
-                    self._decode_dispatch()
+                    # speculative verify round when lanes have drafts;
+                    # otherwise (or under contention) the plain pipelined
+                    # decode-chunk path — gamma collapse makes low-match
+                    # traffic live here permanently
+                    if not self._try_speculate():
+                        self._decode_dispatch()
                 else:
                     self._last_decode_end = None  # idle gap isn't ITL
                 # drain landed readbacks; block on the oldest when the
@@ -1818,6 +1928,9 @@ class LLMEngine:
         slot.position = 0
         slot.pending_token = None
         slot.prefix_ctx = None
+        slot.spec_hist = []
+        slot.spec_ema = 1.0
+        slot.spec_miss = 0
         slot.epoch += 1
         if slot.session:
             # only drop the mapping if it still points HERE — clear_sessions
@@ -1910,7 +2023,16 @@ class LLMEngine:
         # shared system prompt skips ~all of its prefill. Continuing
         # sessions already hold their context in KV; nothing to fork.
         forked = 0
-        if self._prefix_active and slot.position == 0:
+        fresh = slot.position == 0
+        # drafting corpus mirrors the slot's fed token stream exactly: a
+        # fresh context replaces it, a continuing turn appends (the pending
+        # token rides in via the prompt, having been held out at finish)
+        if fresh:
+            slot.spec_hist = list(prompt)
+        else:
+            slot.spec_hist.extend(prompt)
+            del slot.spec_hist[: -self.max_seq]
+        if self._prefix_active and fresh:
             if self._prefix_levels and len(prompt) > self._prefix_levels[0]:
                 hit = self._prefix_lookup(prompt)
                 if hit is not None:
@@ -1970,6 +2092,9 @@ class LLMEngine:
         slot.position = 0
         slot.pending_token = None  # stale state from the previous occupant
         slot.pending_prompt = []
+        slot.spec_hist = []
+        slot.spec_ema = 1.0  # new occupant: optimistic until measured
+        slot.spec_miss = 0
         slot.epoch += 1
         if session:
             self.sessions[session] = slot.idx
@@ -2067,6 +2192,12 @@ class LLMEngine:
         slot.request = None
         slot.last_used = time.monotonic()
         slot.pending_token = (req.generated[-1] if req.generated else None) if pending_last else None
+        # fold the reply into the drafting corpus; a held-out pending token
+        # re-arrives via the next turn's prompt, so it is excluded here
+        slot.spec_hist.extend(
+            req.generated[:-1] if slot.pending_token is not None else req.generated
+        )
+        del slot.spec_hist[: -self.max_seq]
         if slot.decoding:
             # park the lane: in-flight chunks keep decoding it (their tokens
             # are skipped at processing — request identity mismatch) until
@@ -2179,6 +2310,284 @@ class LLMEngine:
             if c >= target:
                 return c
         return self.decode_chunk
+
+    # -- self-speculative decoding (worker thread) ------------------------
+    #
+    # Prompt-lookup drafting: agentic traffic (tool-call JSON, flattened
+    # histories, retrieval-grounded answers) constantly re-emits spans that
+    # already exist in the context, so the slot's OWN token stream is the
+    # draft model — zero extra weights. Per round, a host-side drafter
+    # proposes up to gamma continuation tokens per lane; one compiled
+    # verify forward (t = k+1, the prefill path at per-lane positions)
+    # scores every lane's drafts in parallel; the longest agreeing prefix
+    # is accepted and the slot's KV position is rewound past rejected
+    # tokens (their cache writes sit beyond the live length, where the
+    # position mask hides them until the stream overwrites them — the same
+    # invariant chunked-decode overshoot already relies on). Greedy lanes
+    # are bit-exact with plain decode (acceptance = argmax agreement, the
+    # correction token IS the argmax the plain path would have sampled);
+    # temperature lanes use standard speculative rejection sampling with a
+    # point-mass proposal, which leaves the output distribution unchanged.
+
+    def _verify_fn(self, K: int):
+        """Compiled k-token verify step for draft bucket ``K``: feed each
+        lane [carry_token, draft_0..draft_{K-1}] at positions [p..p+K],
+        accept the longest agreeing draft prefix, and emit accepted drafts
+        plus the model's own token at the first unverified row. Returns
+        (emitted [B,K+1], count [B], new_tok [B], new_pos [B], cache)."""
+        fn = self._verify_fns.get(K)
+        if fn is None:
+            run_forward = self._run_forward
+
+            def verify(params, cache, tok, pos, temps, drafts, dlen, key):
+                scratch = cache.k.shape[2] - 1
+                toks = jnp.concatenate([tok[:, None], drafts], axis=1)  # [B,K+1]
+                offs = jnp.arange(K + 1, dtype=jnp.int32)[None, :]
+                # parked lanes (and padding rows past a lane's draft_len)
+                # clamp at the scratch position, exactly like plain decode
+                positions = jnp.minimum(pos[:, None] + offs, scratch)
+                logits, cache = run_forward(params, toks, positions, cache)
+                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                k_acc, k_bonus = jax.random.split(key)
+                # draft_j (= toks[:, j+1]) is scored by logits row j. Greedy
+                # lanes accept on exact argmax agreement; sampled lanes
+                # accept with prob p_j(draft_j) — rejection sampling with a
+                # point-mass proposal keeps the output distribution intact.
+                u = jax.random.uniform(k_acc, drafts.shape)
+                probs = jax.nn.softmax(
+                    logits[:, :K, :].astype(jnp.float32)
+                    / jnp.maximum(temps, 1e-6)[:, None, None],
+                    axis=-1,
+                )
+                p_draft = jnp.take_along_axis(
+                    probs, drafts[:, :, None], axis=2
+                )[:, :, 0]
+                ok = jnp.where(
+                    temps[:, None] <= 0.0, drafts == greedy[:, :K], u < p_draft
+                )
+                ok = ok & (jnp.arange(K, dtype=jnp.int32)[None, :] < dlen[:, None])
+                a = jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(axis=1)  # [B]
+                # correction/bonus from the first unverified row: on a
+                # rejection the rejected draft is masked out of the residual
+                # (max(p - q, 0) for a point-mass q is p minus that token);
+                # when every draft accepted, row a is the bonus distribution
+                row_a = jnp.take_along_axis(logits, a[:, None, None], axis=1)[:, 0]
+                draft_a = jnp.take_along_axis(
+                    toks, jnp.minimum(a + 1, K)[:, None], axis=1
+                )[:, 0]
+                rejected = a < dlen
+                vocab = jnp.arange(row_a.shape[-1], dtype=jnp.int32)[None, :]
+                row_a = jnp.where(
+                    (vocab == draft_a[:, None]) & rejected[:, None], NEG_INF, row_a
+                )
+                bonus = sample(row_a, k_bonus, temperature=temps).astype(jnp.int32)
+                m = jnp.arange(K + 1, dtype=jnp.int32)[None, :]
+                shifted = jnp.concatenate(
+                    [toks[:, 1:], jnp.zeros_like(tok)[:, None]], axis=1
+                )
+                emitted = jnp.where(m < a[:, None], shifted, 0) + jnp.where(
+                    m == a[:, None], bonus[:, None], 0
+                )
+                count = a + 1
+                new_pos = jnp.minimum(pos + count, scratch)
+                return emitted, count, bonus, new_pos, cache
+
+            fn = self._verify_fns[K] = jax.jit(verify, donate_argnums=(1, 2, 3))
+        return fn
+
+    def _spec_gamma(self, slot: Slot) -> int:
+        """Draft-length policy for one lane: EMA-scaled up to gamma_max,
+        capped by the request's remaining token budget and the arena
+        headroom (drafted positions must stay below scratch). Collapsed
+        (low-EMA) and lookup-missing lanes return 0 except for a short
+        probe draft every SPEC_PROBE_EVERY decode steps, so a workload
+        shift re-opens speculation without taxing the steady state."""
+        req = slot.request
+        if req is None or not req.generated:
+            return 0
+        cap = min(
+            self.spec_gamma_max,
+            req.max_tokens - len(req.generated) - 1,
+            self.max_seq - 2 - slot.position,
+        )
+        if cap <= 0:
+            return 0
+        if slot.spec_ema < SPEC_EMA_FLOOR or slot.spec_miss >= SPEC_MISS_BACKOFF:
+            probe_due = self.decode_steps - slot.spec_probe_at >= SPEC_PROBE_EVERY
+            return min(2, cap) if probe_due else 0
+        return min(max(1, int(round(slot.spec_ema * self.spec_gamma_max))), cap)
+
+    def _spec_draft(self, slot: Slot, gamma: int) -> list[int]:
+        """Prompt-lookup draft: the tokens that followed the most recent
+        earlier occurrence of the stream's trailing n-gram (longest of
+        3-gram / 2-gram). The lookup iterates on the extended stream when
+        a match runs out of continuation before ``gamma`` tokens — a
+        looping stream (tool-call JSON, repeated structure) drafts the
+        whole bucket, not just one cycle's tail. Reverse scans over the
+        slot's fed stream — bounded by max_seq, microseconds next to a
+        model forward."""
+        seq = slot.spec_hist + slot.request.generated
+        base = len(seq)
+        while len(seq) - base < gamma:
+            got = self._spec_lookup(seq, gamma - (len(seq) - base))
+            if not got:
+                break
+            seq.extend(got)
+        return [int(t) for t in seq[base:]]
+
+    @staticmethod
+    def _spec_lookup(seq: list, want: int) -> list:
+        L = len(seq)
+        for n in (3, 2):
+            if L < n + 1:
+                continue
+            pat = seq[L - n :]
+            floor = max(0, L - n - 1 - SPEC_LOOKUP_WINDOW)
+            for i in range(L - n - 1, floor - 1, -1):
+                if seq[i : i + n] == pat:
+                    return seq[i + n : i + n + want]
+        return []
+
+    def _try_speculate(self) -> bool:
+        """Run one speculative verify round if the batch has draftable
+        lanes. Returns True when a round was dispatched-and-processed (the
+        caller skips the plain decode dispatch for this iteration).
+
+        Speculation is a STEADY-STATE optimization: under admission/prefill
+        contention the plain ladder (which shrinks) keeps newcomers fast —
+        a synchronous verify round would block exactly the queue polling
+        that admits them — so contended iterations fall through to the
+        plain path unconditionally."""
+        if not self._spec_active:
+            return False
+        if self._waiting or not self._queue.empty():
+            return False
+        if any(s.request is not None and s.pending_prompt for s in self.slots):
+            return False
+        if not any(
+            s.decoding and s.request is not None and self._spec_gamma(s) > 0
+            for s in self.slots
+        ):
+            return False
+        # drafting needs the host's view of every lane's stream to be
+        # current: drain the readback pipeline (the drain keeps admitting —
+        # _wait_admitting — so this costs sync, not admission latency)
+        while self._readbacks:
+            self._drain_readbacks(block=True)
+            if self._sentinel:
+                return True  # unwind; the worker loop re-checks the sentinel
+        # the drain may have admitted new work: re-check contention
+        if self._waiting or any(
+            s.request is not None and s.pending_prompt for s in self.slots
+        ):
+            return False
+        plan = []
+        any_draft = False
+        for s in self.slots:
+            if not s.decoding or s.request is None:
+                continue
+            g = self._spec_gamma(s)
+            d = self._spec_draft(s, g) if g > 0 else []
+            if g > 0:
+                s.spec_probe_at = self.decode_steps
+                s.spec_miss = 0 if d else s.spec_miss + 1
+            any_draft = any_draft or bool(d)
+            plan.append((s, s.request, s.position, d))
+        if not any_draft:
+            return False
+        self._spec_round(plan)
+        return True
+
+    def _spec_round(self, plan: list) -> None:
+        """Dispatch one verify forward for the whole batch and process it
+        SYNCHRONOUSLY (the next round's drafts depend on these tokens).
+        Every live lane advances at least one token — lanes with no draft
+        this round ride along as a plain decode step (draft_len 0)."""
+        gmax = max(len(d) for _, _, _, d in plan)
+        K = next(b for b in self._spec_buckets if b >= gmax)
+        drafts = np.zeros((self.max_batch, K), dtype=np.int32)
+        dlen = np.zeros((self.max_batch,), dtype=np.int32)
+        for s, _, _, d in plan:
+            if d:
+                drafts[s.idx, : len(d)] = d
+                dlen[s.idx] = len(d)
+        self._rng, key = jax.random.split(self._rng)
+        emitted_dev, count_dev, self._dtok, self._dpos, self.cache = self._verify_fn(
+            K
+        )(
+            self.params,
+            self.cache,
+            self._dtok,
+            self._dpos,
+            self._dtemps,
+            jnp.asarray(drafts),
+            jnp.asarray(dlen),
+            key,
+        )
+        emitted = np.asarray(emitted_dev)  # sync readback: spec rounds don't pipeline
+        count = np.asarray(count_dev)
+        end = time.monotonic()
+        self.spec_rounds += 1
+        self.spec_verify_hist[K] = self.spec_verify_hist.get(K, 0) + 1
+        self.decode_steps += 1
+        self._occupancy_sum += len(plan) / self.max_batch
+        # the whole k+1-token verify streams the weights ONCE (that is the
+        # point of batching the verification) plus each live lane's prefix
+        self.hbm_bytes_read += self.param_hbm_bytes + sum(
+            (p + K // 2) * self._kv_bytes_per_pos for _, _, p, _ in plan
+        )
+        eos = self.tokenizer.eos_id
+        total_used = 0
+        for slot, req, p, d in plan:
+            if slot.request is not req:
+                continue
+            c = int(count[slot.idx])
+            l = int(dlen[slot.idx])
+            self.spec_drafted += l
+            self.spec_accepted += c - 1
+            self.spec_rejected += l - (c - 1)
+            if l:
+                slot.spec_ema = (
+                    1 - SPEC_EMA_ALPHA
+                ) * slot.spec_ema + SPEC_EMA_ALPHA * ((c - 1) / l)
+            outs = emitted[slot.idx]
+            remaining = req.max_tokens - len(req.generated)
+            used = 0
+            hit_eos = False
+            for j in range(min(c, remaining)):
+                used += 1
+                if int(outs[j]) == eos:
+                    hit_eos = True
+                    break
+            req.generated.extend(int(t) for t in outs[:used])
+            req.dispatched += c
+            self.tokens_generated += used
+            total_used += used
+            self.flops_done += used * self.cfg.flops_per_token(p + used // 2)
+            finished = hit_eos or len(req.generated) >= req.max_tokens
+            if finished and used < c:
+                # the used-th token was an ACCEPTED draft — already fed
+                # through the model at position p + used
+                slot.position = p + used + 1
+                slot.dev_position = slot.position
+                self._finish(slot, pending_last=False)
+            elif finished:
+                slot.position = p + c
+                slot.dev_position = slot.position
+                self._finish(slot, pending_last=True)
+            else:
+                # KV rewind: rejected drafts left stale rows at positions
+                # >= p + c; the next fed token overwrites p + c before any
+                # query can attend there, and the position mask hides the
+                # rest until the stream grows past them
+                slot.position = p + c
+                slot.dev_position = slot.position
+                slot.last_used = end
+        if self._last_decode_end is not None and total_used:
+            self.itl_ms_recent.append(
+                1000 * (end - self._last_decode_end) / total_used
+            )
+        self._last_decode_end = end
 
     def _drain_readbacks(self, block: bool) -> None:
         """Process landed readbacks in FIFO order. An entry is forced to

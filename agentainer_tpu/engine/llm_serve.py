@@ -264,6 +264,12 @@ class LLMServeApp:
 
     def _engine_options(self) -> dict:
         opts = dict(self.model_options)
+        # fleet-wide speculative-decoding default (config features.speculative
+        # → daemon exports ATPU_SPECULATIVE → engine env): per-deployment
+        # model options still win
+        env_spec = os.environ.get("ATPU_SPECULATIVE")
+        if env_spec is not None and "speculative" not in opts:
+            opts["speculative"] = env_spec.lower() in ("1", "true", "yes")
         if self.chips:
             # no tp injection: LLMEngine.create derives the parallelism
             # split from the chip budget itself (dense → tp-first, MoE →

@@ -38,7 +38,11 @@ def sample(
 
     filtered = logits
     if top_k > 0:
-        kth = jnp.sort(filtered, axis=-1)[:, -top_k][:, None]
+        # clamp to the vocab: [:, -top_k] with top_k > V wraps around to an
+        # arbitrary mid-distribution threshold and silently corrupts the
+        # filter; top_k >= V must mean "disabled" (every token kept)
+        k = min(int(top_k), logits.shape[-1])
+        kth = jnp.sort(filtered, axis=-1)[:, -k][:, None]
         filtered = jnp.where(filtered < kth, NEG_INF, filtered)
     if top_p < 1.0:
         sorted_logits = jnp.sort(filtered, axis=-1)[:, ::-1]

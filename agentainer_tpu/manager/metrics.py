@@ -150,6 +150,22 @@ class MetricsPlane:
                         "enabled": engine_stats.get("prefix_cache"),
                         "hit_rate": round(hits / lookups, 3) if lookups else None,
                     }
+                # speculative-decoding rollup: the derived acceptance rate
+                # plus draft volume — "is speculation paying for itself on
+                # this agent's traffic, or has gamma collapsed" in one
+                # glance (raw counters stay in the engine dict above)
+                drafted = engine_stats.get("spec_drafted")
+                if drafted is not None:
+                    accepted = engine_stats.get("spec_accepted", 0)
+                    sample["speculative"] = {
+                        "enabled": engine_stats.get("speculative"),
+                        "rounds": engine_stats.get("spec_rounds", 0),
+                        "drafted": drafted,
+                        "accepted": accepted,
+                        "acceptance_rate": (
+                            round(accepted / drafted, 3) if drafted else None
+                        ),
+                    }
                 # deadline/overload rollup: one place answering "is this
                 # agent dropping work, and where" — proxy-side sheds (this
                 # sample's proxy.shed) plus the engine's lifetime policy

@@ -1,0 +1,98 @@
+"""Shared helpers for the engine A/B benchmarks (bench_admission.py,
+bench_prefix.py, bench_overload.py, bench_spec.py).
+
+Every bench follows the same shape: build the SAME tiny-engine config
+twice with one policy flag flipped, drive identical async client traffic
+against both, report percentiles + a headline ratio, and write a one-line
+JSON artifact at the repo root. The percentile/engine/steady-ITL/prompt
+plumbing lives here so a new bench adds only its workload.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def percentile(sorted_xs: list, q: float, ndigits: int = 3):
+    """q-th percentile of an ALREADY-SORTED list (None when empty — a
+    zero-probe env override must not crash the bench)."""
+    if not sorted_xs:
+        return None
+    return round(sorted_xs[min(len(sorted_xs) - 1, int(q * len(sorted_xs)))], ndigits)
+
+
+def p50(xs: list, ndigits: int = 3):
+    """Median of an unsorted list (None when empty)."""
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return round(xs[len(xs) // 2], ndigits)
+
+
+def make_engine(model: str = "tiny", **options):
+    """One benchmark engine (warmup included, so measurements never pay a
+    compile). Callers pass the policy flag under test plus sizing."""
+    from agentainer_tpu.engine.llm import LLMEngine
+
+    return LLMEngine.create(model, options=options)
+
+
+def text_of_tokens(eng, n_tokens: int, phrase: str) -> str:
+    """Grow a repeated phrase until it encodes to >= n_tokens."""
+    reps = max(1, n_tokens // max(1, len(eng.tokenizer.encode(phrase))))
+    text = phrase * reps
+    while len(eng.tokenizer.encode(text)) < n_tokens:
+        text += phrase
+    return text
+
+
+async def steady_itl(
+    eng,
+    passes: int = 2,
+    max_tokens: int = 300,
+    prompt: str = "steady state pass",
+    temperature: float = 0.0,
+) -> float:
+    """Uncontended single-lane wall-clock ms per generated token, best of
+    ``passes`` (a p50 over a handful of chunk samples is too noisy for a
+    <5% regression check on a shared host)."""
+    best = float("inf")
+    for _ in range(passes):
+        t0 = time.monotonic()
+        r = await eng.generate(prompt, max_tokens=max_tokens, temperature=temperature)
+        best = min(best, 1000 * (time.monotonic() - t0) / max(1, r["completion_tokens"]))
+    return round(best, 3)
+
+
+async def steady_itl_interleaved(
+    engines: dict,
+    passes: int = 5,
+    max_tokens: int = 200,
+    prompt: str = "steady state pass",
+) -> dict[str, float]:
+    """Best-of steady ITL per engine, INTERLEAVED across the set:
+    back-to-back rounds on a shared host cancel the machine-noise that
+    sequential measurement (engine A's passes minutes before engine B's)
+    cannot — the regression guard compares policy, not the host's mood."""
+    best: dict[str, float] = {}
+    for _ in range(passes):
+        for mode, eng in engines.items():
+            t0 = time.monotonic()
+            r = await eng.generate(prompt, max_tokens=max_tokens, temperature=0.0)
+            per_tok = 1000 * (time.monotonic() - t0) / max(1, r["completion_tokens"])
+            best[mode] = min(best.get(mode, per_tok), per_tok)
+    return {mode: round(v, 3) for mode, v in best.items()}
+
+
+def write_artifact(filename: str, doc: dict) -> str:
+    """Print the one-line JSON (the driver scrapes stdout) AND write the
+    committed artifact at the repo root. Returns the line."""
+    line = json.dumps(doc)
+    print(line, flush=True)
+    with open(os.path.join(REPO_ROOT, filename), "w") as f:
+        f.write(line + "\n")
+    return line

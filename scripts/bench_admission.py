@@ -35,6 +35,9 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _benchlib import make_engine, percentile as _p
 
 MODEL = os.environ.get("ATPU_ADM_MODEL", "tiny")
 PROBES = int(os.environ.get("ATPU_ADM_PROBES", "32"))
@@ -52,24 +55,14 @@ BURST_K = int(os.environ.get("ATPU_ADM_BURST_K", "6"))
 PROBE_PROMPT = "where does the admission latency go? " * 8
 
 
-def _p(sorted_xs: list, q: float):
-    if not sorted_xs:  # ATPU_ADM_PROBES=0 / _BURST_WAVES=0 must not crash
-        return None
-    return round(sorted_xs[min(len(sorted_xs) - 1, int(q * len(sorted_xs)))], 3)
-
-
 async def _measure(adaptive: bool) -> dict:
-    from agentainer_tpu.engine.llm import LLMEngine
-
-    eng = LLMEngine.create(
+    eng = make_engine(
         MODEL,
-        options={
-            "max_batch": MAX_BATCH,
-            "max_seq": 512,
-            "decode_chunk": DECODE_CHUNK,
-            "prefill_chunk": 32,
-            "adaptive_decode": adaptive,
-        },
+        max_batch=MAX_BATCH,
+        max_seq=512,
+        decode_chunk=DECODE_CHUNK,
+        prefill_chunk=32,
+        adaptive_decode=adaptive,
     )
     try:
         # steady state: long generations with nobody waiting — the ITL

@@ -38,6 +38,9 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _benchlib import make_engine, percentile as _p, steady_itl_interleaved
 
 SMOKE = os.environ.get("ATPU_OVERLOAD_SMOKE", "") not in ("", "0", "false")
 MODEL = os.environ.get("ATPU_OVL_MODEL", "tiny")
@@ -50,44 +53,22 @@ DRAIN_CAP_S = 60.0 if SMOKE else 180.0
 PROMPT = "overload probe: how long is the queue today? "
 
 
-def _p(sorted_xs: list, q: float):
-    if not sorted_xs:
-        return None
-    return round(sorted_xs[min(len(sorted_xs) - 1, int(q * len(sorted_xs)))], 2)
-
-
 def _mk_engine(deadlines: bool):
-    from agentainer_tpu.engine.llm import LLMEngine
-
-    return LLMEngine.create(
+    return make_engine(
         MODEL,
-        options={
-            "max_batch": MAX_BATCH,
-            "max_seq": 512,
-            "decode_chunk": 8,
-            "prefill_chunk": 32,
-            "deadlines": deadlines,
-            # admit up to ~2 batches of backlog, then shed — the engine-level
-            # twin of the proxy's pending watermark
-            "shed_watermark": 3 * MAX_BATCH if deadlines else 0,
-        },
+        max_batch=MAX_BATCH,
+        max_seq=512,
+        decode_chunk=8,
+        prefill_chunk=32,
+        deadlines=deadlines,
+        # admit up to ~2 batches of backlog, then shed — the engine-level
+        # twin of the proxy's pending watermark
+        shed_watermark=3 * MAX_BATCH if deadlines else 0,
     )
 
 
 async def _steady_itl(engines: dict) -> dict[str, float]:
-    """Unloaded single-lane wall-per-token, best of N, INTERLEAVED across
-    the two engines: back-to-back rounds on a shared host cancel the
-    machine-noise that sequential measurement (engine A's passes minutes
-    before engine B's) cannot — the regression guard compares policy, not
-    the host's mood."""
-    best: dict[str, float] = {}
-    for _ in range(5):
-        for mode, eng in engines.items():
-            t0 = time.monotonic()
-            r = await eng.generate("steady state pass", max_tokens=200, temperature=0.0)
-            per_tok = 1000 * (time.monotonic() - t0) / max(1, r["completion_tokens"])
-            best[mode] = min(best.get(mode, per_tok), per_tok)
-    return {mode: round(v, 3) for mode, v in best.items()}
+    return await steady_itl_interleaved(engines, passes=5, max_tokens=200)
 
 
 async def _calibrate(eng) -> tuple[float, float]:

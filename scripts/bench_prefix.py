@@ -33,12 +33,14 @@ root (the committed artifact).
 from __future__ import annotations
 
 import asyncio
-import json
 import os
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _benchlib import make_engine, p50 as _p50, steady_itl, text_of_tokens, write_artifact
 
 MODEL = os.environ.get("ATPU_PFX_MODEL", "tiny")
 PROBES = int(os.environ.get("ATPU_PFX_PROBES", "16"))
@@ -49,35 +51,18 @@ SYS_TOKENS = int(os.environ.get("ATPU_PFX_SYS_TOKENS", "1040"))
 FLAT_TURNS = int(os.environ.get("ATPU_PFX_FLAT_TURNS", "6"))
 
 
-def _p50(xs: list) -> float | None:
-    if not xs:
-        return None
-    xs = sorted(xs)
-    return round(xs[len(xs) // 2], 3)
-
-
 def _mk_engine(prefix_cache: bool):
-    from agentainer_tpu.engine.llm import LLMEngine
-
-    return LLMEngine.create(
+    return make_engine(
         MODEL,
-        options={
-            "max_batch": 4,
-            "max_seq": MAX_SEQ,
-            "decode_chunk": 8,
-            "prefill_chunk": 256,
-            "prefix_cache": prefix_cache,
-        },
+        max_batch=4,
+        max_seq=MAX_SEQ,
+        decode_chunk=8,
+        prefill_chunk=256,
+        prefix_cache=prefix_cache,
     )
 
 
-def _text_of_tokens(eng, n_tokens: int, phrase: str) -> str:
-    """Grow a repeated phrase until it encodes to ≥ n_tokens."""
-    reps = max(1, n_tokens // max(1, len(eng.tokenizer.encode(phrase))))
-    text = phrase * reps
-    while len(eng.tokenizer.encode(text)) < n_tokens:
-        text += phrase
-    return text
+_text_of_tokens = text_of_tokens
 
 
 async def _probe_ttfts(eng, persona: str) -> list[float]:
@@ -95,12 +80,7 @@ async def _probe_ttfts(eng, persona: str) -> list[float]:
 async def _steady_itl(eng) -> float:
     """Wall-clock ms per generated token of an uncontended long
     generation, best of two passes (regression guard)."""
-    best = float("inf")
-    for _ in range(2):
-        t0 = time.monotonic()
-        r = await eng.generate("steady state pass", max_tokens=300, temperature=0.0)
-        best = min(best, 1000 * (time.monotonic() - t0) / max(1, r["completion_tokens"]))
-    return round(best, 3)
+    return await steady_itl(eng, passes=2, max_tokens=300)
 
 
 async def _flattened_turns(eng) -> list[dict]:
@@ -197,14 +177,7 @@ async def run() -> dict:
 
 def main() -> None:
     out = asyncio.run(run())
-    line = json.dumps(out)
-    print(line, flush=True)
-    artifact = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "BENCH_prefix.json",
-    )
-    with open(artifact, "w") as f:
-        f.write(line + "\n")
+    write_artifact("BENCH_prefix.json", out)
     # acceptance guard (ISSUE 2): warm-prefix TTFT ≤ 0.5× the no-cache
     # baseline, steady ITL regression < 5%, and the forks actually skipped
     # the shared prefix (saved tokens account for the difference)

@@ -1,0 +1,46 @@
+"""Sampler filter edges: top_k must clamp to the vocab.
+
+``jnp.sort(...)[:, -top_k]`` with top_k > V wraps around to an arbitrary
+mid-distribution threshold and silently corrupts the filter — top_k >= V
+must mean "keep everything" (the filter disabled), and top_k = V-1 must
+exclude exactly the lowest-logit token.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agentainer_tpu.engine.sampling import sample
+
+V = 8
+
+
+def test_top_k_at_or_above_vocab_is_a_no_op():
+    """top_k == V and top_k > V both keep the full distribution: with the
+    same key they sample the exact token the unfiltered sampler picks."""
+    key = jax.random.PRNGKey(0)
+    logits = jax.random.normal(jax.random.PRNGKey(1), (4, V))
+    for i in range(16):
+        k = jax.random.fold_in(key, i)
+        want = sample(logits, k, temperature=1.0, top_k=0)
+        assert sample(logits, k, temperature=1.0, top_k=V).tolist() == want.tolist()
+        assert (
+            sample(logits, k, temperature=1.0, top_k=V + 7).tolist() == want.tolist()
+        )
+
+
+def test_top_k_vocab_minus_one_excludes_only_the_min():
+    """top_k = V-1 masks exactly the argmin: over many keys at a hot
+    temperature every token EXCEPT the argmin shows up, and the argmin
+    never does."""
+    logits = jnp.asarray(
+        np.linspace(0.0, 1.0, V, dtype=np.float32)[None, :]
+    )  # argmin = 0, unique
+    seen = set()
+    for i in range(300):
+        t = sample(
+            logits, jax.random.PRNGKey(i), temperature=20.0, top_k=V - 1
+        )
+        seen.add(int(t[0]))
+    assert 0 not in seen, seen
+    assert seen == set(range(1, V)), seen

@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all native test t1 test-native test-kernels bench overload spec server dryrun verify clean
+.PHONY: all native test t1 test-native test-kernels bench overload spec chaos server dryrun verify clean
 
 all: native
 
@@ -40,6 +40,13 @@ overload:
 # run drops ATPU_SPEC_SMOKE
 spec:
 	JAX_PLATFORMS=cpu ATPU_SPEC_SMOKE=1 $(PY) scripts/bench_spec.py
+
+# chaos soak: live daemon + engine subprocesses through the seeded fault
+# schedule (store blips, SIGKILLs, slow dispatch, torn AOF, poisoned
+# prefill); asserts the durability invariants and writes BENCH_chaos.json.
+# Fixed seed -> reproducible schedule; full run drops ATPU_CHAOS_SMOKE
+chaos:
+	JAX_PLATFORMS=cpu ATPU_CHAOS_SEED=1337 ATPU_CHAOS_SMOKE=1 $(PY) scripts/chaos_soak.py
 
 server: native
 	$(PY) -m agentainer_tpu.cli server

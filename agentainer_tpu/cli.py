@@ -298,6 +298,51 @@ def cmd_backup(args) -> None:
         print(f"exported to {out}")
 
 
+def cmd_faults(args) -> None:
+    """Inspect/arm/disarm the daemon's fault-injection plane (failpoints).
+
+    Examples:
+        agentainer faults                       # list active failpoints
+        agentainer faults --arm "store.get:error=ConnectionError,count=5"
+        agentainer faults --disarm store.get
+        agentainer faults --clear               # disarm everything
+    """
+    body = {}
+    if getattr(args, "clear", False):
+        body["disarm_all"] = True
+    if args.disarm:
+        body["disarm"] = args.disarm
+    if args.arm:
+        body["arm"] = ";".join(args.arm)
+    if body:
+        doc = _call(args, "POST", "/internal/faults", body)
+        data = doc["data"]
+        for name in data["armed"]:
+            print(f"armed {name}")
+        for name in data["disarmed"]:
+            print(f"disarmed {name}")
+        active = data["active"]
+    else:
+        active = _call(args, "GET", "/internal/faults")["data"]["active"]
+    if not active:
+        print("no failpoints armed")
+        return
+    fmt = "{:<28} {:<20} {:>9} {:>6} {:>7} {:>7} {:>10}"
+    print(fmt.format("NAME", "ERROR", "DELAY_MS", "P", "COUNT", "FIRED", "EVALUATED"))
+    for fp in active:
+        print(
+            fmt.format(
+                fp["name"],
+                fp["error"],
+                fp["delay_ms"],
+                fp["probability"],
+                fp["count"],
+                fp["fired"],
+                fp["evaluated"],
+            )
+        )
+
+
 def cmd_audit(args) -> None:
     path = f"/audit?limit={args.limit}"
     if args.action:
@@ -445,6 +490,24 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("-o", "--output", default="")
     bs.add_parser("list")
     s.set_defaults(fn=cmd_backup)
+
+    s = sub.add_parser(
+        "faults",
+        help="fault-injection plane: list/arm/disarm failpoints on the daemon",
+    )
+    s.add_argument(
+        "--arm",
+        action="append",
+        default=[],
+        metavar="SPEC",
+        help='failpoint spec, e.g. "store.get:error=ConnectionError,'
+        'probability=0.5,seed=7,count=10" (repeatable)',
+    )
+    s.add_argument(
+        "--disarm", action="append", default=[], metavar="NAME", help="disarm one failpoint"
+    )
+    s.add_argument("--clear", action="store_true", help="disarm every failpoint")
+    s.set_defaults(fn=cmd_faults)
 
     s = sub.add_parser("audit", help="audit trail")
     s.add_argument("--limit", type=int, default=50)

@@ -72,6 +72,34 @@ class DeadlineConfig:
 
 
 @dataclass
+class ResilienceConfig:
+    """Crash-loop backoff, store-outage degradation, and fault injection.
+
+    The backoff knobs govern the local backend's restart watcher: a
+    crashed engine respawns immediately once, then with exponential delay
+    (``restart_backoff_base_s`` doubling up to ``restart_backoff_max_s``);
+    an incarnation that dies within ``restart_window_s`` of its spawn
+    counts as a *rapid* death, and after ``restart_max_rapid`` of those in
+    a row the agent lands FAILED with a recorded reason instead of
+    hot-looping forever. The breaker knobs govern the proxy's store
+    circuit breaker (503 + Retry-After instead of hanging on a dead
+    store); the store_retry knobs govern the engine store client's bounded
+    retry. ``faults`` is a failpoint arming spec (agentainer_tpu/faults.py
+    grammar) applied at daemon startup — empty (the default) means the
+    fault plane is entirely disarmed and zero-overhead."""
+
+    restart_backoff_base_s: float = 0.5
+    restart_backoff_max_s: float = 30.0
+    restart_window_s: float = 30.0
+    restart_max_rapid: int = 5
+    store_retries: int = 3
+    store_retry_base_s: float = 0.05
+    breaker_failures: int = 5
+    breaker_cooldown_s: float = 2.0
+    faults: str = ""
+
+
+@dataclass
 class Cadences:
     """Background-loop intervals, reference values (BASELINE.md)."""
 
@@ -88,6 +116,7 @@ class Config:
     features: FeatureFlags = field(default_factory=FeatureFlags)
     cadences: Cadences = field(default_factory=Cadences)
     deadlines: DeadlineConfig = field(default_factory=DeadlineConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     auth_token: str = DEFAULT_TOKEN
     # "auto": native C++ store with AOF durability when the library builds,
     # in-memory store otherwise. Explicit: mem:// | native://[aof-path]
@@ -139,6 +168,32 @@ def load_config(path: str | None = None) -> Config:
     cfg.deadlines.retry_after_s = float(
         dl.get("retry_after_s", cfg.deadlines.retry_after_s)
     )
+    res = doc.get("resilience", {})
+    cfg.resilience.restart_backoff_base_s = float(
+        res.get("restart_backoff_base_s", cfg.resilience.restart_backoff_base_s)
+    )
+    cfg.resilience.restart_backoff_max_s = float(
+        res.get("restart_backoff_max_s", cfg.resilience.restart_backoff_max_s)
+    )
+    cfg.resilience.restart_window_s = float(
+        res.get("restart_window_s", cfg.resilience.restart_window_s)
+    )
+    cfg.resilience.restart_max_rapid = int(
+        res.get("restart_max_rapid", cfg.resilience.restart_max_rapid)
+    )
+    cfg.resilience.store_retries = int(
+        res.get("store_retries", cfg.resilience.store_retries)
+    )
+    cfg.resilience.store_retry_base_s = float(
+        res.get("store_retry_base_s", cfg.resilience.store_retry_base_s)
+    )
+    cfg.resilience.breaker_failures = int(
+        res.get("breaker_failures", cfg.resilience.breaker_failures)
+    )
+    cfg.resilience.breaker_cooldown_s = float(
+        res.get("breaker_cooldown_s", cfg.resilience.breaker_cooldown_s)
+    )
+    cfg.resilience.faults = str(res.get("faults", cfg.resilience.faults))
     sec = doc.get("security", {})
     cfg.auth_token = sec.get("auth_token", cfg.auth_token)
     cfg.store_url = doc.get("store", {}).get("url", cfg.store_url)
@@ -179,6 +234,41 @@ def load_config(path: str | None = None) -> Config:
             "true",
             "yes",
         )
+    if "ATPU_FAULTS" in env:
+        # the env spec REPLACES a config-file spec rather than merging:
+        # an operator arming from the shell must get exactly that schedule
+        cfg.resilience.faults = env["ATPU_FAULTS"]
+
+    def _env_num(name: str, cast, current):
+        # malformed resilience numbers fall back to the config value
+        # instead of refusing to boot (LocalBackend reads the same vars
+        # with the same tolerance — behavior must not depend on which
+        # reader hits them first)
+        raw = env.get(name)
+        if raw is None:
+            return current
+        try:
+            return cast(raw)
+        except ValueError:
+            return current
+
+    res_cfg = cfg.resilience
+    res_cfg.restart_max_rapid = _env_num(
+        "ATPU_RESTART_MAX_RAPID", int, res_cfg.restart_max_rapid
+    )
+    res_cfg.restart_backoff_base_s = _env_num(
+        "ATPU_RESTART_BACKOFF_BASE_S", float, res_cfg.restart_backoff_base_s
+    )
+    res_cfg.restart_backoff_max_s = _env_num(
+        "ATPU_RESTART_BACKOFF_MAX_S", float, res_cfg.restart_backoff_max_s
+    )
+    res_cfg.restart_window_s = _env_num(
+        "ATPU_RESTART_WINDOW_S", float, res_cfg.restart_window_s
+    )
+    res_cfg.store_retries = _env_num("ATPU_STORE_RETRIES", int, res_cfg.store_retries)
+    res_cfg.store_retry_base_s = _env_num(
+        "ATPU_STORE_RETRY_BASE_S", float, res_cfg.store_retry_base_s
+    )
     cfg.features.speculative = bool(
         feats.get("speculative", cfg.features.speculative)
     )

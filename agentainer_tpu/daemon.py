@@ -70,6 +70,22 @@ def build_services(
     # the flag, so this is a write-back of the resolved value — a second
     # build_services with a different config must not inherit a stale latch
     os.environ["ATPU_SPECULATIVE"] = "1" if config.features.speculative else "0"
+    # Fault plane: the registry and the ATPU_FAULTS env the engines inherit
+    # always reflect THIS config's schedule — same write-back-the-resolved-
+    # value discipline as ATPU_SPECULATIVE above: an empty spec must clear a
+    # previously armed registry and the stale env latch, or "faults
+    # disabled" would keep firing in the daemon and every spawned engine.
+    from . import faults as _faults
+
+    _faults.disarm_all()
+    if config.resilience.faults:
+        _faults.arm_spec(config.resilience.faults)
+    os.environ["ATPU_FAULTS"] = config.resilience.faults
+    # engine store clients read their retry policy from the env they
+    # inherit; load_config already folded operator env into the config, so
+    # this is a write-back of the resolved values
+    os.environ["ATPU_STORE_RETRIES"] = str(config.resilience.store_retries)
+    os.environ["ATPU_STORE_RETRY_BASE_S"] = str(config.resilience.store_retry_base_s)
     ddir = data_dir if data_dir is not None else config.data_path
     if store is None:
         url = config.store_url
@@ -89,7 +105,13 @@ def build_services(
     if backend is None:
         from .runtime.local import LocalBackend
 
-        backend = LocalBackend(store=store)
+        backend = LocalBackend(
+            store=store,
+            restart_backoff_base_s=config.resilience.restart_backoff_base_s,
+            restart_backoff_max_s=config.resilience.restart_backoff_max_s,
+            restart_window_s=config.resilience.restart_window_s,
+            restart_max_rapid=config.resilience.restart_max_rapid,
+        )
     elif getattr(backend, "store", "absent") is None:
         backend.store = store  # LocalBackend built without a store: inject ours
     # multi-host note: jax.distributed is joined by the ENGINE subprocesses
@@ -142,7 +164,7 @@ def build_services(
     services.dispatch = app_obj.dispatch_to_agent
     services.app = app_obj.app  # type: ignore[attr-defined]
 
-    services.health = HealthMonitor(manager, store, services.dispatch)
+    services.health = HealthMonitor(manager, store, services.dispatch, logs=logs)
     services.replay = ReplayWorker(
         journal,
         manager,

@@ -54,30 +54,55 @@ def load_params(cfg: ModelConfig, path: str | Path, dtype=jnp.bfloat16) -> dict:
 
 
 # -- KV slot snapshots (engine ↔ store) ---------------------------------
-SNAP_VERSION = 1
+# v2: KV ships in the cache's EXACT dtype (v1 cast everything to fp16,
+# which rounded fp32/bf16 arenas on restore and broke the token-identical
+# resume guarantee under near-tie greedy argmax). bfloat16 has no portable
+# npz encoding (np.savez degrades it to a void dtype), so it travels as a
+# uint16 bit-view with the true dtype recorded in the header.
+SNAP_VERSION = 2
 
 
 def pack_kv_snapshot(k16, v16, position: int, meta: dict | None = None) -> bytes:
-    """Host half of a KV snapshot: block on the staged fp16 device buffers
+    """Host half of a KV snapshot: block on the staged device buffers
     (bucket-padded [L, bucket, KV, hd] — the engine's worker dispatched the
     slice), trim to the live prefix, and pack a self-describing npz blob.
     Only the written prefix ships — a 100-token conversation snapshot is
     ~100/S of the slot arena."""
     k = np.asarray(k16)[:, :position]
     v = np.asarray(v16)[:, :position]
+    dtype_name = k.dtype.name
+    if dtype_name == "bfloat16":
+        k, v = k.view(np.uint16), v.view(np.uint16)
     buf = io.BytesIO()
-    header = json.dumps({"version": SNAP_VERSION, "position": position, **(meta or {})})
+    header = json.dumps(
+        {
+            "version": SNAP_VERSION,
+            "position": position,
+            "dtype": dtype_name,
+            **(meta or {}),
+        }
+    )
     np.savez_compressed(buf, k=k, v=v, header=np.frombuffer(header.encode(), dtype=np.uint8))
     return buf.getvalue()
 
 
 def deserialize_kv_slot(blob: bytes) -> tuple[np.ndarray, np.ndarray, dict]:
-    """Returns (k [L, pos, KV, hd], v, header dict)."""
+    """Returns (k [L, pos, KV, hd], v, header dict) in the snapshot's true
+    dtype. Accepts v1 blobs (fp16 payload) so snapshots taken before an
+    engine upgrade still restore across it."""
     with np.load(io.BytesIO(blob)) as z:
         header = json.loads(bytes(z["header"]).decode())
-        if header.get("version") != SNAP_VERSION:
-            raise ValueError(f"unsupported KV snapshot version: {header.get('version')}")
-        return z["k"], z["v"], header
+        version = header.get("version")
+        k, v = z["k"], z["v"]
+        if version == 1:
+            return k, v, header  # legacy: fp16 as stored
+        if version != SNAP_VERSION:
+            raise ValueError(f"unsupported KV snapshot version: {version}")
+        if header.get("dtype") == "bfloat16":
+            import ml_dtypes
+
+            k, v = k.view(ml_dtypes.bfloat16), v.view(ml_dtypes.bfloat16)
+        return k, v, header
 
 
 def restore_kv_slot(cache: KVCache, slot: int, k: np.ndarray, v: np.ndarray) -> KVCache:

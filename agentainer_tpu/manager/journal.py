@@ -22,6 +22,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any
 
+from .. import faults
 from ..store.base import Store
 from ..store.schema import Keys, REQUEST_TTL_S
 
@@ -157,6 +158,7 @@ class RequestJournal:
         headers: dict[str, str] | None = None,
         body: bytes = b"",
     ) -> None:
+        faults.fire("journal.complete")
         req = self.get(agent_id, request_id)
         if req is None:
             return
@@ -181,6 +183,7 @@ class RequestJournal:
         loser backs off without forwarding anything. A concurrent unrelated
         touch (retry accounting from another dispatch) fails the swap too —
         re-read and retry, bounded."""
+        faults.fire("journal.mark_processing")
         key = Keys.request(agent_id, request_id)
         for _ in range(4):
             raw = self.store.get(key)

@@ -181,6 +181,20 @@ class MetricsPlane:
                         "waiting_depth": engine_stats.get("waiting_depth", 0),
                         "draining": engine_stats.get("draining", False),
                     }
+            # restart-watcher rollup: lives used, crash-loop backoff state,
+            # and the give-up reason for a FAILED agent — "is this agent
+            # flapping" belongs next to its serving counters
+            watch_fn = getattr(self.manager.backend, "watch_stats", None)
+            if watch_fn is not None:
+                try:
+                    watch = watch_fn(agent.engine_id)
+                except Exception:
+                    watch = None
+                if watch:
+                    # the raw attempt-timestamp log is test/debug surface,
+                    # not a 10s history sample
+                    watch.pop("respawn_attempts", None)
+                    sample["restart_watch"] = watch
             # host-process half of the picture (CPU%/RSS via /proc): on a
             # TPU-VM the host side is what throttles serving
             if hasattr(self.manager.backend, "host_stats"):

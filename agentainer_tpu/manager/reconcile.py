@@ -102,6 +102,8 @@ class StateSynchronizer:
         self.interval_s = interval_s
         self._task: asyncio.Task | None = None
         self._unsub = None
+        self.sync_errors_total = 0
+        self.last_error = ""
 
     async def start(self) -> None:
         loop = asyncio.get_running_loop()
@@ -131,8 +133,12 @@ class StateSynchronizer:
                 await asyncio.to_thread(self.quick_sync.sync_all)
             except asyncio.CancelledError:
                 raise
-            except Exception:
-                pass
+            except Exception as e:
+                # survive store blips (next tick retries) but visibly:
+                # a reconciler that dies silently lets desired and actual
+                # state drift until someone notices the hard way
+                self.sync_errors_total += 1
+                self.last_error = f"{type(e).__name__}: {e}"
 
     async def stop(self) -> None:
         if self._unsub:

@@ -19,6 +19,7 @@ import asyncio
 import time
 from typing import Awaitable, Callable
 
+from .. import faults
 from ..core.protocol import DISPATCH_EXPIRED, DISPATCH_IN_FLIGHT
 from ..core.spec import AgentStatus
 from ..manager.agents import AgentManager
@@ -50,6 +51,13 @@ class ReplayWorker:
         self._kick: asyncio.Event | None = None
         self._loop_ref: asyncio.AbstractEventLoop | None = None
         self.replayed_total = 0
+        # store-blip observability: a scan that died (store error walking
+        # the pending lists) and a dispatch that raised (store error inside
+        # dispatch_to_agent) are survivable — the next tick retries — but
+        # they must be countable, not silently passed
+        self.scan_errors_total = 0
+        self.dispatch_errors_total = 0
+        self.last_error = ""
 
     async def start(self) -> None:
         self._loop_ref = asyncio.get_running_loop()
@@ -103,8 +111,11 @@ class ReplayWorker:
                 await self.scan_once()
             except asyncio.CancelledError:
                 raise
-            except Exception:
-                pass
+            except Exception as e:
+                # a store outage mid-scan must not kill the worker — the
+                # cadence retries — but it is counted, not silently passed
+                self.scan_errors_total += 1
+                self.last_error = f"{type(e).__name__}: {e}"
 
     async def scan_once(self) -> int:
         """One replay pass; returns number of successfully replayed requests."""
@@ -124,15 +135,26 @@ class ReplayWorker:
                     self.journal.mark_pending(agent_id, req.id)
                 elif req.status != RequestStatus.PENDING:
                     continue
-                status, _, _ = await self.dispatch(
-                    agent_id,
-                    req.method,
-                    req.path,
-                    req.headers,
-                    req.body,
-                    request_id=req.id,
-                    deadline_at=req.deadline_at,
-                )
+                try:
+                    await faults.fire_async("replay.dispatch")
+                    status, _, _ = await self.dispatch(
+                        agent_id,
+                        req.method,
+                        req.path,
+                        req.headers,
+                        req.body,
+                        request_id=req.id,
+                        deadline_at=req.deadline_at,
+                    )
+                except Exception as e:
+                    # a dispatch that RAISES (store blip inside the proxy's
+                    # settle path, injected fault) is isolated to this
+                    # agent's drain — the other agents' queues still get
+                    # their pass, and the entry stays journaled for the
+                    # next tick
+                    self.dispatch_errors_total += 1
+                    self.last_error = f"{type(e).__name__}: {e}"
+                    break
                 if status == 429:
                     # engine shed the replay (overload): the entry went back
                     # to pending — stop hammering this agent until the next

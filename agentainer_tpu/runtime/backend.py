@@ -38,6 +38,10 @@ class EngineState(str, Enum):
     RUNNING = "running"
     PAUSED = "paused"
     EXITED = "exited"
+    # crash-loop terminal state: the restart watcher gave up after the
+    # rapid-death cap — the reconciler maps it to AgentStatus.FAILED, and
+    # only an explicit start/resume re-arms the respawn policy
+    FAILED = "failed"
 
 
 @dataclass

@@ -23,6 +23,12 @@ def main() -> None:
         format="%(asctime)s %(name)s %(message)s",
         force=True,
     )
+    # fault plane: engine-side failpoints (engine.*, store_client.rpc) arm
+    # from the env the daemon exported; unset = registry empty = no-ops
+    if os.environ.get("ATPU_FAULTS"):
+        from .. import faults
+
+        faults.arm_from_env()
     engine = os.environ.get("AGENTAINER_ENGINE", "echo")
     from ..engine import is_tpu_engine
 

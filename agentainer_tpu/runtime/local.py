@@ -37,6 +37,7 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Callable
 
+from .. import faults
 from ..core.spec import Agent
 from ..store.base import Store
 from .backend import Backend, EngineInfo, EngineState
@@ -77,6 +78,17 @@ class _EngineRec:
     # the shared host process keyed by share_key; proc stays None
     share_key: tuple | None = None
     attached: bool = False
+    # crash-loop accounting (restart watcher): when the current incarnation
+    # was spawned, how many consecutive deaths happened within the rapid
+    # window, when the next respawn is allowed, and whether the watcher gave
+    # up (terminal FAILED until an explicit start/resume re-arms it)
+    last_spawn_at: float = 0.0
+    rapid_deaths: int = 0
+    respawn_pending: bool = False
+    next_respawn_at: float = 0.0
+    gave_up: bool = False
+    failed_reason: str = ""
+    respawn_attempts: list = field(default_factory=list)
 
 
 @dataclass
@@ -105,10 +117,43 @@ class LocalBackend(Backend):
         data_dir: str | Path | None = None,
         python: str = sys.executable,
         ready_timeout_s: float = 60.0,
+        restart_backoff_base_s: float | None = None,
+        restart_backoff_max_s: float | None = None,
+        restart_window_s: float | None = None,
+        restart_max_rapid: int | None = None,
     ):
         self.store = store
         self.python = python
         self.ready_timeout_s = ready_timeout_s
+
+        # crash-loop policy (config resilience.* via build_services; env for
+        # backends constructed directly, e.g. tests and bench harnesses)
+        def _envf(name: str, default: float) -> float:
+            try:
+                return float(os.environ.get(name, default))
+            except ValueError:
+                return default
+
+        self.restart_backoff_base_s = (
+            restart_backoff_base_s
+            if restart_backoff_base_s is not None
+            else _envf("ATPU_RESTART_BACKOFF_BASE_S", 0.5)
+        )
+        self.restart_backoff_max_s = (
+            restart_backoff_max_s
+            if restart_backoff_max_s is not None
+            else _envf("ATPU_RESTART_BACKOFF_MAX_S", 30.0)
+        )
+        self.restart_window_s = (
+            restart_window_s
+            if restart_window_s is not None
+            else _envf("ATPU_RESTART_WINDOW_S", 30.0)
+        )
+        self.restart_max_rapid = int(
+            restart_max_rapid
+            if restart_max_rapid is not None
+            else _envf("ATPU_RESTART_MAX_RAPID", 5)
+        )
         self.control_url = ""
         self.store_sock = ""
         self.internal_token = ""
@@ -217,6 +262,14 @@ class LocalBackend(Backend):
     def start_engine(self, engine_id: str) -> None:
         with self._lock:
             rec = self._require(engine_id)
+            # explicit start/resume re-arms the crash-loop policy: the
+            # operator asked for another life, so the rapid-death latch and
+            # any pending backoff are cleared
+            rec.gave_up = False
+            rec.failed_reason = ""
+            rec.rapid_deaths = 0
+            rec.respawn_pending = False
+            rec.next_respawn_at = 0.0
             if rec.share_key is not None:
                 rec.desired_running = True
             elif rec.proc is not None and rec.proc.poll() is None:
@@ -261,6 +314,7 @@ class LocalBackend(Backend):
             rec.port = port
             rec.attached = True
             rec.paused = False
+            rec.last_spawn_at = time.monotonic()
 
     def _spawn_host(self, rec: _EngineRec) -> _HostRec:
         """Build + spawn the shared engine process from a tenant's env (the
@@ -433,6 +487,7 @@ class LocalBackend(Backend):
             start_new_session=True,  # isolate signals from the daemon
         )
         rec.paused = False
+        rec.last_spawn_at = time.monotonic()
 
     def _wait_ready(self, rec: _EngineRec) -> None:
         """Block until the engine answers /health (containers have no such
@@ -554,6 +609,10 @@ class LocalBackend(Backend):
             )
 
     def _state(self, rec: _EngineRec) -> EngineState:
+        if rec.gave_up:
+            # crash-loop terminal: the watcher stopped respawning; only an
+            # explicit start/resume (which clears the latch) leaves FAILED
+            return EngineState.FAILED
         if rec.share_key is not None:
             if not rec.attached and not rec.desired_running:
                 return EngineState.CREATED if rec.restarts == 0 else EngineState.EXITED
@@ -715,6 +774,17 @@ class LocalBackend(Backend):
                 pass
 
     # -- restart-policy watcher (docker events + RestartPolicy analogue) --
+    #
+    # Respawn policy (crash-loop backoff): the FIRST death of a healthy
+    # incarnation respawns on the next 200 ms tick — single-crash recovery
+    # time is unchanged. Consecutive *rapid* deaths (an incarnation that
+    # lived < restart_window_s) back off exponentially
+    # (restart_backoff_base_s doubling, capped at restart_backoff_max_s),
+    # and past restart_max_rapid of them the agent lands FAILED with a
+    # recorded reason instead of hot-respawning forever — the 0.2 s
+    # hot-loop used to burn a CPU core re-paying model load for an engine
+    # that dies on boot, and made the failure invisible (status flapped
+    # stopped→running instead of settling anywhere diagnosable).
     def _watch_loop(self) -> None:
         last: dict[str, EngineState] = {}
         while not self._closed:
@@ -733,21 +803,103 @@ class LocalBackend(Backend):
                     and rec.auto_restart
                     and not self._closed
                 ):
-                    try:
-                        if rec.share_key is not None:
-                            # host died: respawn it and re-attach this tenant
-                            rec.attached = False
-                            self._ensure_host_and_attach(rec)
-                            rec.restarts += 1
-                        else:
-                            with self._lock:
-                                self._spawn(rec)
-                                rec.restarts += 1
-                            self._wait_ready(rec)
-                        self._emit(rec.engine_id, EngineState.RUNNING)
-                        last[rec.engine_id] = EngineState.RUNNING
-                    except Exception:
-                        rec.desired_running = False
+                    self._maybe_respawn(rec, last)
+
+    def _backoff_delay(self, rapid_deaths: int) -> float:
+        """Respawn delay after the n-th consecutive rapid death: 0 for the
+        first death (fast single-crash recovery), then exponential."""
+        if rapid_deaths <= 1:
+            return 0.0
+        return min(
+            self.restart_backoff_max_s,
+            self.restart_backoff_base_s * (2 ** (rapid_deaths - 2)),
+        )
+
+    def _give_up(self, rec: _EngineRec, reason: str) -> None:
+        rec.gave_up = True
+        rec.failed_reason = reason
+        rec.respawn_pending = False
+        rec.next_respawn_at = 0.0
+        print(
+            f"[backend] engine {rec.engine_id} (agent {rec.agent_id}) FAILED: {reason}",
+            flush=True,
+        )
+
+    def _maybe_respawn(self, rec: _EngineRec, last: dict[str, EngineState]) -> None:
+        now = time.monotonic()
+        if not rec.respawn_pending:
+            # first observation of THIS death: classify it against the
+            # previous incarnation's lifetime and schedule the respawn
+            lived = now - rec.last_spawn_at if rec.last_spawn_at else float("inf")
+            rec.rapid_deaths = (
+                rec.rapid_deaths + 1 if lived < self.restart_window_s else 1
+            )
+            if rec.rapid_deaths > self.restart_max_rapid:
+                self._give_up(
+                    rec,
+                    f"crash loop: {rec.rapid_deaths - 1} consecutive deaths within "
+                    f"{self.restart_window_s:.0f}s of spawn (cap {self.restart_max_rapid})",
+                )
+                return
+            rec.respawn_pending = True
+            rec.next_respawn_at = now + self._backoff_delay(rec.rapid_deaths)
+        if now < rec.next_respawn_at:
+            return  # backing off; a later tick retries
+        rec.respawn_attempts.append(now)
+        del rec.respawn_attempts[:-64]  # bounded attempt log for watch_stats
+        try:
+            faults.fire("watcher.respawn")
+            if rec.share_key is not None:
+                # host died: respawn it and re-attach this tenant
+                rec.attached = False
+                self._ensure_host_and_attach(rec)
+                rec.restarts += 1
+            else:
+                with self._lock:
+                    self._spawn(rec)
+                    rec.restarts += 1
+                self._wait_ready(rec)
+            rec.respawn_pending = False
+            rec.next_respawn_at = 0.0
+            self._emit(rec.engine_id, EngineState.RUNNING)
+            last[rec.engine_id] = EngineState.RUNNING
+        except Exception as e:
+            # a failed respawn (spawn error, died during startup, injected
+            # fault) is itself a rapid death: back off harder, and land
+            # FAILED at the cap instead of abandoning the desired state
+            # silently like the old watcher did
+            rec.rapid_deaths += 1
+            if rec.rapid_deaths > self.restart_max_rapid:
+                self._give_up(rec, f"respawn failing: {type(e).__name__}: {e}")
+            else:
+                rec.next_respawn_at = (
+                    time.monotonic() + self._backoff_delay(rec.rapid_deaths)
+                )
+
+    def watch_stats(self, engine_id: str) -> dict | None:
+        """Restart-watcher accounting for the health/metrics planes: how
+        many lives this engine has had, whether it is crash-looping, and
+        why it was given up on."""
+        with self._lock:
+            rec = self._recs.get(engine_id)
+            if rec is None:
+                return None
+            backoff = 0.0
+            if rec.respawn_pending:
+                backoff = max(0.0, rec.next_respawn_at - time.monotonic())
+            return {
+                "restarts": rec.restarts,
+                "rapid_deaths": rec.rapid_deaths,
+                # respawn_pending covers the backoff==0.0 windows too (an
+                # attempt in flight, or the delay just elapsed): consumers
+                # deciding "does the watcher own this engine's recovery"
+                # must gate on it, not on the remaining-delay number
+                "respawn_pending": rec.respawn_pending,
+                "respawn_backoff_s": round(backoff, 3),
+                "crash_looping": rec.gave_up,
+                "failed_reason": rec.failed_reason or None,
+                "respawn_attempts": list(rec.respawn_attempts),
+            }
 
     def close(self) -> None:
         self._closed = True

@@ -19,10 +19,14 @@ from __future__ import annotations
 
 import asyncio
 import os
+import random
 import struct
 from typing import Any
 
 import aiohttp
+
+from .. import faults
+from ..core.resilience import backoff_delays
 
 # single source of truth for the native wire codec: agentainer_tpu.store.native
 # mirrors native/common.h; importing it has no side effects (CDLL load is lazy)
@@ -41,6 +45,17 @@ _OP_NUM = {
     )
 }
 _OP_NUM["delete"] = _wire.OP_DEL
+
+# Transport-shaped failures a retry can reasonably fix: the connection died,
+# the peer vanished mid-frame, or the wait timed out. Everything else —
+# protocol violations, auth rejections, programming errors — must surface
+# unchanged; retrying those only hides the bug and delays the caller.
+TRANSIENT_ERRORS = (
+    OSError,  # ConnectionError and friends are subclasses
+    asyncio.TimeoutError,
+    asyncio.IncompleteReadError,  # EOFError subclass: peer closed mid-frame
+    aiohttp.ClientConnectionError,
+)
 
 
 class _UDSPool:
@@ -87,7 +102,10 @@ class _UDSPool:
                     self._made += 1
                     try:
                         conn = await self._connect()
-                    except Exception:
+                    except BaseException:
+                        # ANY failure un-counts the slot (accounting, not
+                        # classification — leaking it would shrink the pool
+                        # forever); the exception itself propagates unchanged
                         self._made -= 1
                         raise
         if conn is None:
@@ -97,9 +115,26 @@ class _UDSPool:
             writer.write(struct.pack("<I", len(frame)) + frame)
             await writer.drain()
             resp = await self._read_resp(reader)
-        except Exception:
+        except TRANSIENT_ERRORS:
+            # transport failure: this connection is dead or desynced — drop
+            # it (the next call dials fresh) and let the caller's bounded
+            # retry decide whether to go again
             self._made -= 1
             writer.close()
+            raise
+        except BaseException as e:
+            # unexpected (codec bug, cancellation): the connection may be
+            # mid-frame and can't be reused either, but the error must
+            # surface loudly as what it is — not silently degrade into
+            # "store op failed" like the old blanket handler
+            self._made -= 1
+            writer.close()
+            if not isinstance(e, asyncio.CancelledError):
+                print(
+                    f"[store-client] non-transport error on store socket: "
+                    f"{type(e).__name__}: {e}",
+                    flush=True,
+                )
             raise
         self._free.put_nowait(conn)
         return resp
@@ -119,6 +154,8 @@ class StoreClient:
         token: str = "",
         agent_id: str = "",
         store_sock: str = "",
+        retries: int | None = None,
+        retry_base_s: float | None = None,
     ):
         self.control_url = control_url.rstrip("/")
         self.token = token
@@ -130,6 +167,28 @@ class StoreClient:
             if store_sock and agent_id and token
             else None
         )
+        # Bounded retry + jittered exponential backoff for TRANSIENT
+        # transport errors only (a refused/reset connection, a timeout, a
+        # torn frame) — a store blip must degrade one op's latency, not
+        # fail the request it serves. Non-idempotency caveat: an ack lost
+        # in flight can double-apply an rpush on retry; that costs at worst
+        # a duplicated conversation turn, which the durability guarantee
+        # tolerates (same envelope as Redis client retries).
+        if retries is None:
+            try:
+                retries = int(os.environ.get("ATPU_STORE_RETRIES", "3"))
+            except ValueError:
+                retries = 3
+        if retry_base_s is None:
+            try:
+                retry_base_s = float(os.environ.get("ATPU_STORE_RETRY_BASE_S", "0.05"))
+            except ValueError:
+                retry_base_s = 0.05
+        self.retries = max(0, retries)
+        self.retry_base_s = retry_base_s
+        self._retry_rng = random.Random(0xA70)  # deterministic jitter
+        self.retries_total = 0
+        self.transient_errors_total = 0
 
     @classmethod
     def from_env(cls) -> "StoreClient":
@@ -240,31 +299,46 @@ class StoreClient:
             }
         return None  # set/ltrim/set_b64
 
+    async def _with_retry(self, attempt):
+        """Run one transport attempt, retrying TRANSIENT_ERRORS on the
+        jittered backoff schedule; anything else surfaces immediately.
+        The schedule is built lazily on the FIRST failure: the happy path
+        pays nothing, and the deterministic jitter sequence is a function
+        of failures, not of total op count."""
+        delays: list[float] | None = None
+        n = 0
+        while True:
+            try:
+                return await attempt()
+            except TRANSIENT_ERRORS:
+                self.transient_errors_total += 1
+                if delays is None:
+                    delays = backoff_delays(
+                        self.retries, base_s=self.retry_base_s, rng=self._retry_rng
+                    )
+                if n >= len(delays):
+                    raise
+                self.retries_total += 1
+                await asyncio.sleep(delays[n])
+                n += 1
+
     async def _op(self, op: str, key: str, **kw: Any) -> Any:
-        if self._uds is not None:
-            status, vals = await self._uds.roundtrip(self._encode_sub(op, key, kw))
-            return self._decode_result(op, status, vals)
         if not self.connected:
             return self._local_op(op, key, **kw)
-        return await self._post({"op": op, "key": key, **kw}, f"op {op}")
+
+        async def attempt():
+            # failpoint cut INSIDE the retry loop: an injected transient
+            # error exercises the recovery path, not just the failure path
+            await faults.fire_async("store_client.rpc")
+            if self._uds is not None:
+                status, vals = await self._uds.roundtrip(self._encode_sub(op, key, kw))
+                return self._decode_result(op, status, vals)
+            return await self._post({"op": op, "key": key, **kw}, f"op {op}")
+
+        return await self._with_retry(attempt)
 
     async def pipeline(self, ops: list[dict[str, Any]]) -> list[Any]:
         """Run a batch of ops in one round-trip (each: {op, key, ...})."""
-        if self._uds is not None:
-            subs = [
-                self._encode_sub(
-                    o["op"], o["key"], {k: v for k, v in o.items() if k not in ("op", "key")}
-                )
-                for o in ops
-            ]
-            status, vals = await self._uds.roundtrip(_enc(_OP_NUM["pipeline"], subs))
-            if status != 0:
-                raise RuntimeError(
-                    vals[0].decode("utf-8", "replace") if vals else "pipeline failed"
-                )
-            return [
-                self._decode_result(o["op"], *_dec(raw)) for o, raw in zip(ops, vals)
-            ]
         if not self.connected:
             return [
                 self._local_op(
@@ -272,7 +346,27 @@ class StoreClient:
                 )
                 for o in ops
             ]
-        return await self._post({"op": "pipeline", "ops": ops}, "pipeline") or []
+
+        async def attempt():
+            await faults.fire_async("store_client.rpc")
+            if self._uds is not None:
+                subs = [
+                    self._encode_sub(
+                        o["op"], o["key"], {k: v for k, v in o.items() if k not in ("op", "key")}
+                    )
+                    for o in ops
+                ]
+                status, vals = await self._uds.roundtrip(_enc(_OP_NUM["pipeline"], subs))
+                if status != 0:
+                    raise RuntimeError(
+                        vals[0].decode("utf-8", "replace") if vals else "pipeline failed"
+                    )
+                return [
+                    self._decode_result(o["op"], *_dec(raw)) for o, raw in zip(ops, vals)
+                ]
+            return await self._post({"op": "pipeline", "ops": ops}, "pipeline") or []
+
+        return await self._with_retry(attempt)
 
     def _local_op(self, op: str, key: str, **kw: Any) -> Any:
         d = self._local

@@ -32,7 +32,9 @@ from typing import TYPE_CHECKING
 
 from aiohttp import ClientSession, ClientTimeout, web
 
+from .. import faults
 from ..core.errors import AgentainerError, AgentNotFound
+from ..core.resilience import CircuitBreaker
 from ..core.spec import AgentStatus, HealthCheckConfig, ModelRef, Resources
 from ..manager.journal import RequestStatus
 from ..store.schema import Keys
@@ -145,6 +147,16 @@ class ControlPlaneApp:
         # check stays O(1) per proxied request (staleness bound: a burst can
         # overshoot the global ceiling by ~one cache window of arrivals)
         self._global_pending_cache: tuple[float, int] = (0.0, 0)
+        # store circuit breaker: when journaling flaps, the proxy answers
+        # fast (503 + Retry-After, or serve-through for a running agent)
+        # instead of stacking store timeouts on every request
+        res = getattr(services.config, "resilience", None)
+        self._store_breaker = CircuitBreaker(
+            failure_threshold=getattr(res, "breaker_failures", 5),
+            cooldown_s=getattr(res, "breaker_cooldown_s", 2.0),
+        )
+        self.journal_errors_total = 0
+        self.journal_skipped_total = 0
         self.app.on_startup.append(self._on_startup)
         self.app.on_cleanup.append(self._on_cleanup)
 
@@ -226,6 +238,10 @@ class ControlPlaneApp:
         r.add_get("/slice", self.h_slice)
         r.add_post("/internal/store", self.h_internal_store)
         r.add_post("/internal/engines/ready", self.h_engine_ready)
+        # fault-injection plane: NOT in the public path list, so the admin
+        # bearer middleware guards it — arming failpoints is an operator act
+        r.add_get("/internal/faults", self.h_faults_get)
+        r.add_post("/internal/faults", self.h_faults_post)
         r.add_post("/artifacts", self.h_artifact_build)
         r.add_get("/artifacts", self.h_artifact_list)
         r.add_delete("/artifacts/{name}", self.h_artifact_remove)
@@ -667,6 +683,56 @@ class ControlPlaneApp:
         self.s.logs.info("engine", f"agent {agent_id} reports model ready")
         return ok({"kicked": True})
 
+    # -- fault-injection plane (docs/RESILIENCE.md §Fault injection) ------
+    async def h_faults_get(self, request: web.Request) -> web.Response:
+        return ok(
+            {
+                "active": faults.active(),
+                "store_breaker": self._store_breaker.stats(),
+                "journal_errors_total": self.journal_errors_total,
+                "journal_skipped_total": self.journal_skipped_total,
+            }
+        )
+
+    async def h_faults_post(self, request: web.Request) -> web.Response:
+        """Arm/disarm failpoints at runtime (admin bearer token).
+
+        Body: ``{"arm": "<spec string>"}`` or ``{"arm": [{name, error,
+        delay_ms, probability, count, seed}, ...]}``, ``{"disarm":
+        ["name", ...]}``, ``{"disarm_all": true}`` — combinable; disarms
+        apply first so one call can replace a schedule atomically."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return fail("invalid JSON body", status=400)
+        armed: list[str] = []
+        disarmed: list[str] = []
+        try:
+            if body.get("disarm_all"):
+                disarmed = [fp["name"] for fp in faults.active()]
+                faults.disarm_all()
+            for name in body.get("disarm", []) or []:
+                if faults.disarm(str(name)):
+                    disarmed.append(str(name))
+            spec = body.get("arm")
+            if isinstance(spec, str) and spec:
+                armed += faults.arm_spec(spec)
+            elif isinstance(spec, list):
+                for kw in spec:
+                    if not isinstance(kw, dict) or "name" not in kw:
+                        return fail("each arm entry needs a 'name'", status=400)
+                    faults.arm(**{k: v for k, v in kw.items()})
+                    armed.append(kw["name"])
+        except (TypeError, ValueError) as e:
+            return fail(f"bad failpoint spec: {e}", status=400)
+        self._audit(
+            request,
+            "faults",
+            f"arm={','.join(armed) or '-'} disarm={','.join(disarmed) or '-'}",
+            "success",
+        )
+        return ok({"armed": armed, "disarmed": disarmed, "active": faults.active()})
+
     # -- internal store API for engine subprocesses -----------------------
     async def h_internal_store(self, request: web.Request) -> web.Response:
         """Store access for engine processes.
@@ -872,7 +938,13 @@ class ControlPlaneApp:
                 # unserved — a fast 429 + Retry-After lets a well-behaved
                 # caller back off while under-watermark traffic still gets
                 # its 202/200
-                reason = self._shed_reason(agent_id, dl)
+                try:
+                    reason = self._shed_reason(agent_id, dl)
+                except Exception:
+                    # depth accounting is store-backed: during a blip,
+                    # admit rather than shed on unknowable depths
+                    self._store_breaker.fail()
+                    reason = ""
                 if reason:
                     self.s.metrics.count_shed(agent_id)
                     return fail(
@@ -880,19 +952,59 @@ class ControlPlaneApp:
                         status=429,
                         headers={"Retry-After": str(max(1, int(round(dl.retry_after_s))))},
                     )
-            journaled = self.s.journal.store_request(
-                agent_id, request.method, path, headers, body, deadline_at=deadline_at
-            )
-            request_id = journaled.id
+            # Journal behind the store circuit breaker: with the store dark
+            # the proxy must not stack a timeout per request. Degradation
+            # ladder: breaker open or journaling failing → a RUNNING agent
+            # still serves (without durability, counted + logged); an agent
+            # that is down cannot honor the 202 queue-for-replay contract,
+            # so the caller gets a fast 503 + Retry-After instead of a 202
+            # whose entry was never durably written.
+            if not self._store_breaker.allow():
+                self.journal_skipped_total += 1
+            else:
+                try:
+                    journaled = self.s.journal.store_request(
+                        agent_id,
+                        request.method,
+                        path,
+                        headers,
+                        body,
+                        deadline_at=deadline_at,
+                    )
+                    self._store_breaker.ok()
+                    request_id = journaled.id
+                except Exception as e:
+                    self._store_breaker.fail()
+                    self.journal_errors_total += 1
+                    self.journal_skipped_total += 1
+                    try:
+                        self.s.logs.warn(
+                            "proxy",
+                            f"journaling failed for {agent_id} "
+                            f"({type(e).__name__}: {e}); serving without durability",
+                            agent_id=agent_id,
+                        )
+                    except Exception:
+                        pass  # the log plane rides the same store
 
         if agent.status != AgentStatus.RUNNING:
-            if persist:
+            if persist and request_id:
                 # "agent down → 202 + queue for replay" (server.go:525-541)
                 return ok(
                     {"request_id": request_id, "status": "pending"},
                     message="Agent is not running. Request queued and will be "
                     "replayed when the agent is back.",
                     status=202,
+                )
+            if persist:
+                return fail(
+                    "store unavailable; request cannot be queued for replay",
+                    status=503,
+                    headers={
+                        "Retry-After": str(
+                            max(1, int(round(self._store_breaker.cooldown_s)))
+                        )
+                    },
                 )
             return fail("agent is not running", status=503)
 
@@ -991,6 +1103,29 @@ class ControlPlaneApp:
                 return f"engine queue depth {depth} >= {dl.engine_queue_watermark}"
         return ""
 
+    def _journal_op(self, fn, *args, **kw):
+        """Best-effort journal settlement: a store blip mid-settle must not
+        turn an already-served engine response into a 500. The entry stays
+        in its previous state (usually PROCESSING); the replay worker's
+        staleness reclaim repairs it, and the engine's idempotency memo
+        guarantees the eventual re-dispatch cannot execute twice."""
+        try:
+            result = fn(*args, **kw)
+            self._store_breaker.ok()
+            return result
+        except Exception as e:
+            self._store_breaker.fail()
+            self.journal_errors_total += 1
+            try:
+                self.s.logs.warn(
+                    "proxy",
+                    f"journal settle {getattr(fn, '__name__', fn)!s} failed: "
+                    f"{type(e).__name__}: {e}",
+                )
+            except Exception:
+                pass  # the log plane is store-backed too
+            return None
+
     async def _abort_dispatch(self, agent_id: str, request_id: str) -> None:
         """Client disconnected mid-dispatch: dead-letter the journal entry
         (no waiter → replaying it is waste) and tell the engine to stop
@@ -1047,31 +1182,55 @@ class ControlPlaneApp:
             return DISPATCH_ENGINE_GONE, {}, b""
         if deadline_at is not None and time.time() > deadline_at:
             if request_id:
-                self.s.journal.mark_expired(agent_id, request_id, reason="deadline exceeded")
+                self._journal_op(
+                    self.s.journal.mark_expired,
+                    agent_id,
+                    request_id,
+                    reason="deadline exceeded",
+                )
             return DISPATCH_EXPIRED, {}, b""
         if request_id:
             if force:
-                self.s.journal.mark_processing(agent_id, request_id)
-            elif not self.s.journal.acquire_processing(agent_id, request_id):
-                return DISPATCH_IN_FLIGHT, {}, b""
+                self._journal_op(self.s.journal.mark_processing, agent_id, request_id)
+            else:
+                try:
+                    claimed = self.s.journal.acquire_processing(agent_id, request_id)
+                except Exception:
+                    # can't verify the claim with the store dark — another
+                    # dispatcher may own the entry, so do NOT forward: the
+                    # entry replays when the store returns (durability over
+                    # latency; no double execution)
+                    self._store_breaker.fail()
+                    self.journal_errors_total += 1
+                    claimed = False
+                if not claimed:
+                    return DISPATCH_IN_FLIGHT, {}, b""
 
         if endpoint.startswith("fake://"):
             # in-process dispatch for the unit-test backend
             handler = getattr(self.s.backend, "handle_request", None)
             if handler is None:
                 if request_id:
-                    self.s.journal.mark_pending(agent_id, request_id)
+                    self._journal_op(self.s.journal.mark_pending, agent_id, request_id)
                 return DISPATCH_ENGINE_GONE, {}, b""
             try:
+                faults.fire("proxy.dispatch")
                 status, resp_headers, resp_body = handler(
                     agent.engine_id, method, path, headers, body
                 )
             except ConnectionError:
                 if request_id:
-                    self.s.journal.mark_pending(agent_id, request_id)
+                    self._journal_op(self.s.journal.mark_pending, agent_id, request_id)
                 return DISPATCH_ENGINE_GONE, {}, b""
             if request_id:
-                self.s.journal.store_response(agent_id, request_id, status, resp_headers, resp_body)
+                self._journal_op(
+                    self.s.journal.store_response,
+                    agent_id,
+                    request_id,
+                    status,
+                    resp_headers,
+                    resp_body,
+                )
             self.s.metrics.count_request(agent_id)
             return status, resp_headers, resp_body
 
@@ -1098,6 +1257,10 @@ class ControlPlaneApp:
         import aiohttp
 
         try:
+            # failpoint: injected ConnectionError classifies as engine-gone
+            # (crash heuristic), TimeoutError as retry-accounted failure,
+            # delay_ms as a slow engine — the chaos soak drives all three
+            await faults.fire_async("proxy.dispatch")
             async with self._client.request(
                 method,
                 url,
@@ -1109,18 +1272,28 @@ class ControlPlaneApp:
                 resp_headers = dict(resp.headers)
         except (aiohttp.ClientConnectorError, ConnectionError) as e:
             if request_id:
-                self.s.journal.mark_pending(agent_id, request_id)
+                self._journal_op(self.s.journal.mark_pending, agent_id, request_id)
             return DISPATCH_ENGINE_GONE, {}, b""
         except (asyncio.TimeoutError, aiohttp.ClientError, OSError) as e:
             if deadline_at is not None and time.time() > deadline_at:
                 # the wait ran out the caller's budget: dead-letter and tell
                 # the engine to stop — a retry would also arrive too late
                 if request_id:
-                    self.s.journal.mark_expired(agent_id, request_id, reason="deadline exceeded")
+                    self._journal_op(
+                        self.s.journal.mark_expired,
+                        agent_id,
+                        request_id,
+                        reason="deadline exceeded",
+                    )
                     await self._cancel_on_engine(endpoint, request_id)
                 return DISPATCH_EXPIRED, {}, b""
             if request_id:
-                self.s.journal.mark_failed(agent_id, request_id, f"{type(e).__name__}: {e}")
+                self._journal_op(
+                    self.s.journal.mark_failed,
+                    agent_id,
+                    request_id,
+                    f"{type(e).__name__}: {e}",
+                )
             return DISPATCH_FAILED, {}, b""
         if resp.status == 503 and (
             resp_headers.get(LOADING_HEADER, "").lower() == "true"
@@ -1131,13 +1304,18 @@ class ControlPlaneApp:
             # engine-gone — stays pending, no retry charged, the replay
             # worker re-dispatches once it is back
             if request_id:
-                self.s.journal.mark_pending(agent_id, request_id)
+                self._journal_op(self.s.journal.mark_pending, agent_id, request_id)
             return DISPATCH_ENGINE_GONE, {}, b""
         if resp_headers.get(EXPIRED_HEADER, "").lower() == "true":
             # the engine dropped it by deadline policy: dead-letter, don't
             # archive a 504 as a completed response
             if request_id:
-                self.s.journal.mark_expired(agent_id, request_id, reason="expired on engine")
+                self._journal_op(
+                    self.s.journal.mark_expired,
+                    agent_id,
+                    request_id,
+                    reason="expired on engine",
+                )
             return DISPATCH_EXPIRED, {}, b""
         if resp.status == 429:
             # engine-side shed: overload is transient — the entry goes back
@@ -1146,11 +1324,16 @@ class ControlPlaneApp:
             # guarantee), while a live caller still sees the 429 +
             # Retry-After to back off on its own
             if request_id:
-                self.s.journal.mark_pending(agent_id, request_id)
+                self._journal_op(self.s.journal.mark_pending, agent_id, request_id)
             return resp.status, resp_headers, resp_body
         if request_id:
-            self.s.journal.store_response(
-                agent_id, request_id, resp.status, resp_headers, resp_body
+            self._journal_op(
+                self.s.journal.store_response,
+                agent_id,
+                request_id,
+                resp.status,
+                resp_headers,
+                resp_body,
             )
         self.s.metrics.count_request(agent_id, latency_s=time.monotonic() - t0)
         return resp.status, resp_headers, resp_body
@@ -1166,7 +1349,24 @@ class ControlPlaneApp:
         budget = 30.0 if deadline_at is None else max(0.5, deadline_at - time.time())
         end = time.monotonic() + min(30.0, budget)
         while time.monotonic() < end:
-            req = self.s.journal.get(agent_id, request_id)
+            try:
+                req = self.s.journal.get(agent_id, request_id)
+            except Exception:
+                # the store died between journaling and here: answer fast
+                # with the degradation contract instead of surfacing a 500
+                # (the entry is durably journaled — it replays when the
+                # store returns)
+                self._store_breaker.fail()
+                self.journal_errors_total += 1
+                return fail(
+                    "store unavailable; request state unknown, will replay",
+                    status=503,
+                    headers={
+                        "Retry-After": str(
+                            max(1, int(round(self._store_breaker.cooldown_s)))
+                        )
+                    },
+                )
             if req is None:
                 return None
             if req.status == RequestStatus.COMPLETED and req.response:

@@ -20,6 +20,7 @@ import threading
 import time
 from typing import Callable
 
+from .. import faults
 from .base import Store, Subscription, _to_bytes
 
 
@@ -62,6 +63,7 @@ class MemoryStore(Store):
 
     # -- strings ---------------------------------------------------------
     def set(self, key: str, value: bytes | str, ttl: float | None = None) -> None:
+        faults.fire("store.set")  # outside the lock: a delay must not block readers
         with self._lock:
             self._data[key] = _to_bytes(value)
             if ttl is None:
@@ -70,6 +72,7 @@ class MemoryStore(Store):
                 self._expiry[key] = time.time() + ttl
 
     def get(self, key: str) -> bytes | None:
+        faults.fire("store.get")
         with self._lock:
             if not self._live(key):
                 return None
@@ -117,6 +120,7 @@ class MemoryStore(Store):
         new: bytes | str,
         ttl: float | None = None,
     ) -> bool:
+        faults.fire("store.cas")
         # under the SAME lock every other mutation takes: atomic against
         # concurrent set/delete, not just against other cas callers
         with self._lock:

@@ -15,6 +15,7 @@ import struct
 import threading
 from typing import Any, Callable
 
+from .. import faults
 from ..native import load
 from .base import Store, Subscription, _to_bytes
 
@@ -176,9 +177,11 @@ class NativeStore(Store):
 
     # -- strings ----------------------------------------------------------
     def set(self, key: str, value: bytes | str, ttl: float | None = None) -> None:
+        faults.fire("store.set")
         self._cmd(OP_SET, key, value, "" if ttl is None else repr(float(ttl)))
 
     def get(self, key: str) -> bytes | None:
+        faults.fire("store.get")
         status, vals = self._cmd(OP_GET, key)
         return None if status == RESP_NIL else vals[0]
 
@@ -208,6 +211,7 @@ class NativeStore(Store):
         new: bytes | str,
         ttl: float | None = None,
     ) -> bool:
+        faults.fire("store.cas")
         exp = None if expected is None else _to_bytes(expected)
         with self._cas_lock:
             if self.get(key) != exp:
@@ -364,6 +368,7 @@ class NativeStore(Store):
         self._cmd(OP_FLUSH)
 
     def aof_flush(self) -> None:
+        faults.fire("store.aof_flush")
         if self._enter():
             try:
                 self._lib.atpu_aof_flush(self._handle)

@@ -20,7 +20,20 @@ static const char* WRONGTYPE = "WRONGTYPE key holds another value type";
 
 Store::Store(const std::string& aof_path) {
   if (!aof_path.empty()) {
-    aof_load(aof_path);
+    long valid = aof_load(aof_path);
+    if (valid >= 0) {
+      // Torn tail (crash mid-append): replay stopped at the last complete
+      // record. TRUNCATE the file to that offset before reopening for
+      // append — appending after torn bytes would strand every
+      // post-recovery write behind an unparseable record, silently losing
+      // them on the NEXT reopen.
+      if (::truncate(aof_path.c_str(), valid) != 0) {
+        // truncate failed (perms?): refuse to append after garbage
+        std::fprintf(stderr, "[atpu-store] aof truncate to %ld failed: %d\n",
+                     valid, errno);
+        return;
+      }
+    }
     aof_ = std::fopen(aof_path.c_str(), "ab");
     if (aof_) sync_thread_ = std::thread(&Store::aof_sync_loop, this);
   }
@@ -549,9 +562,9 @@ void Store::aof_flush() {
   if (aof_) std::fflush(aof_);
 }
 
-void Store::aof_load(const std::string& path) {
+long Store::aof_load(const std::string& path) {
   std::FILE* f = std::fopen(path.c_str(), "rb");
-  if (!f) return;
+  if (!f) return -1;
   std::string buf;
   char chunk[1 << 16];
   size_t n;
@@ -560,16 +573,18 @@ void Store::aof_load(const std::string& path) {
   size_t pos = 0;
   while (pos + 4 <= buf.size()) {
     uint32_t rec_len = get_u32(reinterpret_cast<const uint8_t*>(buf.data() + pos));
-    pos += 4;
-    if (pos + rec_len > buf.size()) break;  // truncated tail record: stop
+    if (pos + 4 + rec_len > buf.size()) break;  // truncated tail record: stop
     Request req;
-    if (parse_request(reinterpret_cast<const uint8_t*>(buf.data() + pos), rec_len,
-                      &req)) {
+    if (parse_request(reinterpret_cast<const uint8_t*>(buf.data() + pos + 4),
+                      rec_len, &req)) {
       std::lock_guard<std::mutex> lk(mu_);
       execute_locked(req, nullptr);
     }
-    pos += rec_len;
+    pos += 4 + rec_len;
   }
+  // bytes of the last COMPLETE record replayed: the constructor truncates
+  // any torn tail to here so reopen-and-continue appends stay parseable
+  return static_cast<long>(pos);
 }
 
 }  // namespace atpu

@@ -67,7 +67,9 @@ class Store {
   Value* typed_locked(const std::string& key, Value::Type t, bool create, std::string* err);
   std::string execute_locked(const Request& req, std::string* aof_out);
   void aof_append(const std::string& rec);
-  void aof_load(const std::string& path);
+  // replays the AOF; returns the byte offset of the last complete record
+  // (the valid length a torn tail is truncated to), -1 if no file
+  long aof_load(const std::string& path);
 
   std::mutex mu_;
   std::unordered_map<std::string, Value> data_;

@@ -1,0 +1,510 @@
+"""Chaos soak: a live daemon + real engine subprocesses driven through a
+SEEDED fault schedule, asserting the resilience invariants end to end.
+
+The five mechanisms behind the durability guarantee (journal, replay,
+health, reconciler, deadline plane — docs/RESILIENCE.md) are each unit-
+tested, but control-plane/data-plane reliability splits break down where
+they *cooperate* under failure. This harness runs the real stack —
+control plane, proxy, journal, replay worker, restart watcher, engine
+subprocesses — through deterministic fault phases:
+
+  engine_sigkill    SIGKILL the echo engine mid-traffic (watcher respawn,
+                    crash heuristic, replay drain)
+  store_blip        seeded-probability store.get/set failpoints (breaker,
+                    serve-through degradation, loop survival)
+  slow_dispatch     proxy.dispatch delay failpoint (latency, not loss)
+  poisoned_prefill  engine.prefill failpoint inside a real LLM engine
+                    subprocess (per-request isolation: the engine survives)
+  llm_sigkill       SIGKILL the LLM host process, then token-identical
+                    session resume from the KV snapshot
+  torn_aof          truncate the native store's AOF mid-record; reopen
+                    recovers every complete record and keeps appending
+
+Invariants asserted (exit nonzero on violation):
+
+  * no acked request lost — every 202-acked id settles COMPLETED, every
+    200 was delivered synchronously;
+  * no double execution — no chat message appears twice in the agent's
+    recorded history, acked ones appear exactly once;
+  * journal pending converges to 0 for every agent;
+  * sessions resume token-identical after an engine SIGKILL;
+  * per-fault-class recovery time (MTTR) is recorded.
+
+Deterministic: the schedule, failpoint probabilities, and traffic are all
+derived from ATPU_CHAOS_SEED (default 1337). ATPU_CHAOS_SMOKE=1 shortens
+traffic volumes (make chaos). Emits one JSON line; the committed artifact
+is BENCH_chaos.json.
+
+Usage: JAX_PLATFORMS=cpu python scripts/chaos_soak.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from _benchlib import write_artifact  # noqa: E402
+
+from agentainer_tpu import faults  # noqa: E402
+from agentainer_tpu.config import Config  # noqa: E402
+from agentainer_tpu.daemon import (  # noqa: E402
+    build_services,
+    start_background,
+    stop_background,
+)
+from agentainer_tpu.runtime.local import LocalBackend  # noqa: E402
+from agentainer_tpu.store import MemoryStore  # noqa: E402
+
+SEED = int(os.environ.get("ATPU_CHAOS_SEED", "1337"))
+SMOKE = os.environ.get("ATPU_CHAOS_SMOKE", "") not in ("", "0", "false")
+TOKEN = "chaos-token"
+AUTH = {"Authorization": f"Bearer {TOKEN}"}
+RECOVERY_CAP_S = 90.0
+
+
+class Soak:
+    def __init__(self, tmpdir: str):
+        self.tmpdir = tmpdir
+        self.services = None
+        self.client = None
+        self.seq = 0
+        # message -> ack kind ("sync" 200 | "queued" 202 rid | "refused")
+        self.acks: dict[str, dict] = {}
+        self.mttr: dict[str, float] = {}
+        self.counts = {"sent": 0, "ok": 0, "queued": 0, "refused": 0, "error5xx": 0}
+        self.violations: list[str] = []
+
+    # -- stack lifecycle --------------------------------------------------
+    async def start(self) -> None:
+        from aiohttp.test_utils import TestClient, TestServer
+
+        cfg = Config()
+        cfg.auth_token = TOKEN
+        # tight cadences so the soak observes recovery, not scan timers
+        cfg.cadences.replay_scan_s = 1.0
+        cfg.cadences.state_sync_s = 2.0
+        cfg.cadences.metrics_interval_s = 5.0
+        cfg.resilience.restart_backoff_base_s = 0.2
+        cfg.resilience.breaker_cooldown_s = 0.5
+        backend = LocalBackend(
+            data_dir=self.tmpdir,
+            ready_timeout_s=90.0,
+            restart_backoff_base_s=cfg.resilience.restart_backoff_base_s,
+            restart_backoff_max_s=2.0,
+            restart_window_s=cfg.resilience.restart_window_s,
+            restart_max_rapid=cfg.resilience.restart_max_rapid,
+        )
+        self.services = build_services(
+            config=cfg,
+            store=MemoryStore(),
+            backend=backend,
+            console_logs=False,
+            data_dir=self.tmpdir,
+        )
+        self.client = TestClient(TestServer(self.services.app))
+        await self.client.start_server()
+        backend.set_control(f"http://127.0.0.1:{self.client.server.port}", TOKEN)
+        await start_background(self.services)
+
+    async def stop(self) -> None:
+        faults.disarm_all()
+        if self.services is not None:
+            await stop_background(self.services)
+            self.services.backend.close()
+        if self.client is not None:
+            await self.client.close()
+
+    async def deploy(self, name: str, model, auto_restart: bool = True, env=None) -> str:
+        resp = await self.client.post(
+            "/agents",
+            json={
+                "name": name,
+                "model": model,
+                "auto_restart": auto_restart,
+                "env": env or {},
+            },
+            headers=AUTH,
+        )
+        doc = await resp.json()
+        assert resp.status == 200, doc
+        agent_id = doc["data"]["id"]
+        resp = await self.client.post(f"/agents/{agent_id}/start", headers=AUTH)
+        assert resp.status == 200, await resp.text()
+        return agent_id
+
+    # -- traffic ----------------------------------------------------------
+    async def chat(self, agent_id: str, track: bool = True, session: str | None = None):
+        """One proxied chat with a unique message; records the ack kind."""
+        self.seq += 1
+        msg = f"chaos-{SEED}-{self.seq}"
+        body = {"message": msg}
+        if session is not None:
+            body["session"] = session
+        resp = await self.client.post(
+            f"/agent/{agent_id}/chat", data=json.dumps(body)
+        )
+        raw = await resp.read()
+        self.counts["sent"] += 1
+        rec = {"status": resp.status, "agent_id": agent_id, "rid": ""}
+        if resp.status == 200:
+            self.counts["ok"] += 1
+            rec["kind"] = "sync"
+        elif resp.status == 202:
+            self.counts["queued"] += 1
+            rec["kind"] = "queued"
+            try:
+                rec["rid"] = json.loads(raw)["data"]["request_id"]
+            except Exception:
+                pass
+        elif resp.status >= 500 or resp.status == 429:
+            self.counts["refused"] += 1
+            if resp.status >= 500:
+                self.counts["error5xx"] += 1
+            rec["kind"] = "refused"
+        if track:
+            self.acks[msg] = rec
+        return resp.status, msg
+
+    async def probe_until_ok(self, agent_id: str, label: str) -> float:
+        """MTTR probe: wall time until the agent serves a 200 again."""
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < RECOVERY_CAP_S:
+            status, _ = await self.chat(agent_id, track=True)
+            if status == 200:
+                mttr = time.monotonic() - t0
+                self.mttr[label] = round(mttr, 3)
+                return mttr
+            await asyncio.sleep(0.2)
+        self.violations.append(f"{label}: no recovery within {RECOVERY_CAP_S}s")
+        self.mttr[label] = -1.0
+        return -1.0
+
+    async def drain_pending(self, agent_id: str, cap_s: float = 45.0) -> bool:
+        """Wait for the replay worker to drain the agent's queue to 0."""
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < cap_s:
+            stats = self.services.journal.stats(agent_id)
+            if stats["pending"] == 0:
+                return True
+            await asyncio.sleep(0.25)
+        return False
+
+    # -- phases -----------------------------------------------------------
+    async def phase_baseline(self, echo_id: str, n: int) -> None:
+        for _ in range(n):
+            status, msg = await self.chat(echo_id)
+            if status != 200:
+                self.violations.append(f"baseline: {msg} got {status}")
+
+    async def phase_engine_sigkill(self, echo_id: str) -> None:
+        engine_id = self.services.manager.get_agent(echo_id).engine_id
+        self.services.backend.kill_engine_hard(engine_id)
+        # fire into the dead window: these ack 502 (left pending) or 202
+        for _ in range(3):
+            await self.chat(echo_id)
+            await asyncio.sleep(0.05)
+        await self.probe_until_ok(echo_id, "engine_sigkill")
+
+    async def phase_store_blip(self, echo_id: str, n: int) -> None:
+        # seeded 50% store read/write failures, budget-bounded so the blip
+        # ENDS deterministically even under the background loops' traffic
+        faults.arm("store.get", error="ConnectionError", probability=0.5, seed=SEED, count=60)
+        faults.arm("store.set", error="ConnectionError", probability=0.5, seed=SEED + 1, count=40)
+        t0 = time.monotonic()
+        for _ in range(n):
+            await self.chat(echo_id)
+            await asyncio.sleep(0.05)
+        # burn any remaining budget through the store, then disarm
+        while any(fp["count"] != 0 for fp in faults.active()):
+            try:
+                self.services.store.get("chaos:burn")
+                self.services.store.set("chaos:burn", "x")
+            except ConnectionError:
+                pass
+            await asyncio.sleep(0)  # the background loops keep breathing
+            if time.monotonic() - t0 > 30:
+                break
+        faults.disarm_all()
+        await self.probe_until_ok(echo_id, "store_blip")
+
+    async def phase_slow_dispatch(self, echo_id: str, n: int) -> None:
+        faults.arm("proxy.dispatch", error="none", delay_ms=250, count=n)
+        t0 = time.monotonic()
+        for _ in range(n):
+            status, msg = await self.chat(echo_id)
+            if status != 200:
+                self.violations.append(f"slow_dispatch: {msg} got {status}")
+        faults.disarm_all()
+        self.mttr["slow_dispatch"] = round((time.monotonic() - t0) / max(1, n), 3)
+
+    async def phase_poisoned_prefill(self, poison_id: str) -> None:
+        """The poison agent's engine armed engine.prefill (count=2) from its
+        env: the first two prefills fail (isolated to their requests), the
+        engine SURVIVES and serves everything after."""
+        failures = 0
+        for _ in range(4):
+            status, _ = await self.chat(poison_id, track=False)
+            if status >= 500:
+                failures += 1
+            await asyncio.sleep(0.1)
+        if failures == 0:
+            self.violations.append(
+                "poisoned_prefill: failpoint never fired (seam not wired?)"
+            )
+        await self.probe_until_ok(poison_id, "poisoned_prefill")
+
+    async def phase_llm_resume(self, llm_id: str) -> bool:
+        """Token-identical resume: control session runs turn1+turn2 clean;
+        victim session runs turn1, the engine is SIGKILLed, and after the
+        watcher respawns it the victim's turn2 (restored from the KV
+        snapshot) must match the control's turn2 bit for bit."""
+
+        async def turn(session: str, message: str) -> tuple[int, str]:
+            resp = await self.client.post(
+                f"/agent/{llm_id}/chat",
+                data=json.dumps(
+                    {"message": message, "session": session, "max_tokens": 12}
+                ),
+            )
+            doc = await resp.json()
+            return resp.status, doc.get("response", "")
+
+        status, _ = await turn("ctl", "alpha alpha alpha")
+        assert status == 200, f"llm ctl turn1 got {status}"
+        status, ctl_t2 = await turn("ctl", "beta beta")
+        assert status == 200, f"llm ctl turn2 got {status}"
+        status, _ = await turn("vic", "alpha alpha alpha")
+        assert status == 200, f"llm vic turn1 got {status}"
+        # The resume guarantee is conditional on a snapshot EXISTING: the
+        # engine's limiter defers stagings (durability floor 30 s from the
+        # session's first attempt). Wait for the victim's snapshot to land
+        # durably — never landing inside the floor is itself a violation.
+        kv_key = f"agent:{llm_id}:kvcache:vic"
+        t_snap = time.monotonic()
+        while self.services.store.get(kv_key) is None:
+            if time.monotonic() - t_snap > 45.0:
+                self.violations.append(
+                    "llm resume: KV snapshot never landed within the "
+                    "durability floor"
+                )
+                return False
+            await asyncio.sleep(0.25)
+
+        engine_id = self.services.manager.get_agent(llm_id).engine_id
+        self.services.backend.kill_engine_hard(engine_id)
+        # recovery probes use a THROWAWAY session: a probe that 502s leaves
+        # a pending journal entry that later REPLAYS — pointed at the
+        # victim session it would append extra turns and desync the
+        # context the token-identical comparison depends on
+        t0 = time.monotonic()
+        recovered = False
+        while time.monotonic() - t0 < RECOVERY_CAP_S:
+            status, _ = await turn("probe-resume", "ping")
+            if status == 200:
+                recovered = True
+                break
+            await asyncio.sleep(0.5)
+        self.mttr["llm_sigkill"] = round(time.monotonic() - t0, 3) if recovered else -1.0
+        if not recovered:
+            self.violations.append("llm_sigkill: engine never served again")
+            return False
+        status, vic_t2 = await turn("vic", "beta beta")
+        if status != 200:
+            self.violations.append(f"llm resume: vic turn2 got {status}")
+            return False
+        if vic_t2 != ctl_t2:
+            self.violations.append(
+                f"token-identical resume violated: {vic_t2!r} != {ctl_t2!r}"
+            )
+            return False
+        return True
+
+    # -- invariant settlement ---------------------------------------------
+    async def settle(self, agent_ids: list[str]) -> dict:
+        inv = {}
+        pending_zero = True
+        for aid in agent_ids:
+            if not await self.drain_pending(aid):
+                pending_zero = False
+                self.violations.append(
+                    f"pending did not converge to 0 for {aid}: "
+                    f"{self.services.journal.stats(aid)}"
+                )
+        inv["pending_converges_to_zero"] = pending_zero
+
+        # every QUEUED ack must have settled COMPLETED (no acked loss)
+        lost = []
+        for msg, rec in self.acks.items():
+            if rec["kind"] == "queued" and rec["rid"]:
+                req = self.services.journal.get(rec["agent_id"], rec["rid"])
+                if req is None or req.status != "completed":
+                    lost.append((msg, None if req is None else req.status))
+        if lost:
+            self.violations.append(f"acked-but-lost requests: {lost[:5]}")
+        inv["no_acked_request_lost"] = not lost
+
+        # history-based exactly-once: NO message may appear twice (double
+        # execution). Presence is required only for QUEUED acks — a 202's
+        # work executes via replay once engine+store are healthy. A sync
+        # 200 during a store blip is DELIVERED but its conversation record
+        # is best-effort (the echo engine explicitly chooses availability
+        # over convo durability when the store is dark) — counted as
+        # degradation, not loss.
+        doubles, missing, degraded = [], [], 0
+        by_agent: dict[str, list[str]] = {}
+        for msg, rec in self.acks.items():
+            by_agent.setdefault(rec["agent_id"], []).append(msg)
+        for aid, msgs in by_agent.items():
+            resp = await self.client.get(f"/agent/{aid}/history")
+            if resp.status != 200:
+                continue  # llm resume agent history is session-keyed; checked above
+            hist = (await resp.json()).get("history", [])
+            contents = [t.get("content", "") for t in hist]
+            for msg in msgs:
+                n = contents.count(msg)
+                if n > 1:
+                    doubles.append((msg, n))
+                elif n == 0 and self.acks[msg]["kind"] == "queued":
+                    missing.append(msg)
+                elif n == 0 and self.acks[msg]["kind"] == "sync":
+                    degraded += 1
+        if doubles:
+            self.violations.append(f"double execution: {doubles[:5]}")
+        if missing:
+            self.violations.append(f"queued-acked messages missing from history: {missing[:5]}")
+        inv["no_double_execution"] = not doubles
+        inv["queued_messages_recorded"] = not missing
+        self.counts["history_degraded"] = degraded
+        return inv
+
+
+def torn_aof_check(tmpdir: str) -> dict | None:
+    """Native-store AOF torn-tail invariant: truncating mid-record loses
+    ONLY the torn record; reopen keeps every complete one AND post-recovery
+    appends survive the next reopen (the truncate-before-append fix)."""
+    try:
+        from agentainer_tpu.native import available
+
+        if not available():
+            return None
+        from agentainer_tpu.store.native import NativeStore
+    except Exception:
+        return None
+    path = os.path.join(tmpdir, "chaos.aof")
+    s = NativeStore(aof_path=path)
+    for i in range(8):
+        s.set(f"k{i}", f"v{i}")
+    s.rpush("torn-list", "x", "y")
+    s.close()
+    with open(path, "r+b") as f:
+        f.seek(0, os.SEEK_END)
+        f.truncate(f.tell() - 3)  # tear the last record mid-bytes
+    t0 = time.monotonic()
+    s2 = NativeStore(aof_path=path)
+    recovered = all(s2.get(f"k{i}") == f"v{i}".encode() for i in range(8))
+    torn_dropped = s2.lrange("torn-list", 0, -1) == []
+    s2.set("after-recovery", "ok")
+    s2.close()
+    s3 = NativeStore(aof_path=path)
+    continue_ok = s3.get("after-recovery") == b"ok" and s3.get("k0") == b"v0"
+    s3.close()
+    return {
+        "recovered_complete_records": recovered,
+        "torn_record_dropped": torn_dropped,
+        "reopen_and_continue": continue_ok,
+        "mttr_s": round(time.monotonic() - t0, 3),
+    }
+
+
+async def run_soak(tmpdir: str) -> dict:
+    soak = Soak(tmpdir)
+    n_base = 4 if SMOKE else 8
+    n_blip = 6 if SMOKE else 12
+    n_slow = 3 if SMOKE else 6
+    try:
+        await soak.start()
+        echo_id = await soak.deploy("chaos-echo", "echo")
+        llm_id = await soak.deploy(
+            "chaos-llm",
+            {
+                "engine": "llm",
+                "config": "tiny",
+                "options": {
+                    "max_batch": 2,
+                    "max_seq": 256,
+                    "prefill_chunk": 64,
+                    "kv_snapshot_interval_s": 0.5,
+                },
+            },
+        )
+        poison_id = await soak.deploy(
+            "chaos-poison",
+            {
+                "engine": "llm",
+                "config": "tiny",
+                # distinct options → distinct share key → its OWN host
+                # process, so the poison env cannot leak into chaos-llm
+                "options": {"max_batch": 1, "max_seq": 128, "prefill_chunk": 32},
+            },
+            env={"ATPU_FAULTS": "engine.prefill:error=RuntimeError,count=2"},
+        )
+
+        await soak.phase_baseline(echo_id, n_base)
+        await soak.phase_engine_sigkill(echo_id)
+        await soak.phase_store_blip(echo_id, n_blip)
+        await soak.phase_slow_dispatch(echo_id, n_slow)
+        await soak.phase_poisoned_prefill(poison_id)
+        token_identical = await soak.phase_llm_resume(llm_id)
+
+        inv = await soak.settle([echo_id, poison_id, llm_id])
+        inv["token_identical_resume"] = token_identical
+    finally:
+        await soak.stop()
+    aof = torn_aof_check(tmpdir)
+    if aof is not None:
+        inv["aof_torn_tail_recovery"] = all(
+            v for k, v in aof.items() if k != "mttr_s"
+        )
+        soak.mttr["torn_aof"] = aof["mttr_s"]
+    return {
+        "invariants": inv,
+        "mttr_s": soak.mttr,
+        "counts": soak.counts,
+        "violations": soak.violations,
+        "aof": aof,
+    }
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    tmpdir = tempfile.mkdtemp(prefix="atpu-chaos-")
+    result = asyncio.run(run_soak(tmpdir))
+    ok = not result["violations"] and all(result["invariants"].values())
+    doc = {
+        "metric": "chaos_soak_invariants",
+        "value": 1 if ok else 0,
+        "unit": "pass",
+        "seed": SEED,
+        "smoke": SMOKE,
+        "platform": os.environ.get("JAX_PLATFORMS", ""),
+        **result,
+        "wall_s": round(time.monotonic() - t0, 1),
+    }
+    write_artifact("BENCH_chaos.json", doc)
+    if not ok:
+        print(f"CHAOS SOAK FAILED: {result['violations']}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

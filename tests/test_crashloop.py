@@ -1,0 +1,123 @@
+"""Crash-loop backoff in the LocalBackend restart watcher (ISSUE 5).
+
+The old watcher hot-respawned a dying engine every 0.2 s forever (and a
+single FAILED respawn silently abandoned the desired state). The policy
+under test: first death respawns fast, consecutive rapid deaths back off
+exponentially, and past the cap the engine lands FAILED with a recorded
+reason — terminal until an explicit start re-arms it.
+"""
+
+import sys
+import time
+
+import pytest
+
+from agentainer_tpu.core.spec import Agent, AgentStatus, ModelRef
+from agentainer_tpu.manager.reconcile import engine_to_agent_status
+from agentainer_tpu.runtime.backend import EngineState
+from agentainer_tpu.runtime.local import LocalBackend
+
+DIE_CMD = [sys.executable, "-c", "import sys; sys.exit(3)"]
+
+
+def _agent() -> Agent:
+    return Agent(id="ag-loop", name="loop", model=ModelRef(engine="echo"), auto_restart=True)
+
+
+def _wait_state(backend, eid, state, timeout_s=30.0) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        info = backend.engine_info(eid)
+        if info is not None and info.state == state:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+@pytest.fixture
+def backend(tmp_path):
+    b = LocalBackend(
+        data_dir=str(tmp_path),
+        ready_timeout_s=30.0,
+        restart_backoff_base_s=0.4,
+        restart_backoff_max_s=5.0,
+        restart_window_s=10.0,
+        restart_max_rapid=3,
+    )
+    yield b
+    b.close()
+
+
+def test_crash_loop_backs_off_then_lands_failed(backend):
+    """Respawn attempts over time: exactly the cap's worth, exponentially
+    spaced, then FAILED with a reason — not an unbounded 0.2 s hot loop."""
+    agent = _agent()
+    eid = backend.create_engine(agent, chips=(0,))
+    backend.start_engine(eid)
+    assert _wait_state(backend, eid, EngineState.RUNNING, 15.0)
+
+    # sabotage the respawn command so every next incarnation dies on boot,
+    # then crash the live engine: the watcher enters a crash loop
+    rec = backend._recs[eid]
+    rec.cmd = list(DIE_CMD)
+    t_kill = time.monotonic()
+    backend.kill_engine_hard(eid)
+
+    assert _wait_state(backend, eid, EngineState.FAILED, 30.0), backend.watch_stats(eid)
+    stats = backend.watch_stats(eid)
+    assert stats["crash_looping"] is True
+    assert stats["failed_reason"], stats
+    assert stats["rapid_deaths"] > 3  # past the cap
+
+    # respawn attempts were counted and SPACED OUT, not a hot loop: with
+    # base 0.4 the gaps grow ~0.4 then ~0.8 (+0.2s watcher tick jitter)
+    attempts = [t - t_kill for t in stats["respawn_attempts"]]
+    assert len(attempts) == 3, attempts  # one per allowed rapid death
+    gaps = [b - a for a, b in zip(attempts, attempts[1:])]
+    assert gaps[0] >= 0.35, gaps  # delay 0.4 (± the 0.2s watcher tick)
+    assert gaps[1] >= 0.75, gaps  # delay 0.8: doubled, not linear/hot
+    assert gaps[1] > gaps[0], gaps
+
+    # the watcher has genuinely stopped: no new attempts accrue
+    n = len(stats["respawn_attempts"])
+    time.sleep(1.0)
+    assert len(backend.watch_stats(eid)["respawn_attempts"]) == n
+
+    # reconciler maps the terminal state to a FAILED agent record
+    assert engine_to_agent_status(EngineState.FAILED) == AgentStatus.FAILED
+
+
+def test_explicit_start_rearms_a_failed_engine(backend):
+    agent = _agent()
+    eid = backend.create_engine(agent, chips=(0,))
+    backend.start_engine(eid)
+    assert _wait_state(backend, eid, EngineState.RUNNING, 15.0)
+    rec = backend._recs[eid]
+    good_cmd = list(rec.cmd)
+    rec.cmd = list(DIE_CMD)
+    backend.kill_engine_hard(eid)
+    assert _wait_state(backend, eid, EngineState.FAILED, 30.0)
+
+    # operator intervention: fix the cause, start again → latch cleared
+    rec.cmd = good_cmd
+    backend.start_engine(eid)
+    assert _wait_state(backend, eid, EngineState.RUNNING, 15.0)
+    stats = backend.watch_stats(eid)
+    assert stats["crash_looping"] is False
+    assert stats["rapid_deaths"] == 0
+    assert stats["failed_reason"] is None
+
+
+def test_single_crash_still_recovers_fast(backend):
+    """The backoff must not tax the common case: ONE crash of a healthy
+    engine respawns on the next watcher tick, like it always did."""
+    agent = _agent()
+    eid = backend.create_engine(agent, chips=(0,))
+    backend.start_engine(eid)
+    assert _wait_state(backend, eid, EngineState.RUNNING, 15.0)
+    t0 = time.monotonic()
+    backend.kill_engine_hard(eid)
+    assert _wait_state(backend, eid, EngineState.RUNNING, 15.0)
+    # watcher tick 0.2s + echo engine boot; well under any backoff delay
+    assert time.monotonic() - t0 < 10.0
+    assert backend.watch_stats(eid)["crash_looping"] is False

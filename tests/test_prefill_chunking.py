@@ -64,9 +64,13 @@ def test_decode_interleaves_with_long_prefill():
 
     async def scenario():
         loop = asyncio.get_running_loop()
-        # session A: long generation under way (decode_chunk=2 → many steps)
+        # session A: long generation under way (decode_chunk=2 → many
+        # steps). ignore_eos pins the stream at exactly 200 tokens: the
+        # tiny random-weight model's greedy argmax lands on EOS after a
+        # handful of steps, which used to end A before B's prefill even
+        # started — the interleaving under test needs a long-lived decode
         task_a = loop.create_task(
-            engine.chat(session="a", message="short", max_tokens=200)
+            engine.chat(session="a", message="short", max_tokens=200, ignore_eos=True)
         )
         # wait until A is genuinely MID-decode (a fixed sleep races the
         # host's speed: on a fast machine A used to finish inside it and

@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all native test t1 test-native test-kernels bench overload spec chaos server dryrun verify clean
+.PHONY: all native test t1 test-native test-kernels bench overload spec paged chaos server dryrun verify clean
 
 all: native
 
@@ -40,6 +40,13 @@ overload:
 # run drops ATPU_SPEC_SMOKE
 spec:
 	JAX_PLATFORMS=cpu ATPU_SPEC_SMOKE=1 $(PY) scripts/bench_spec.py
+
+# paged KV arena A/B (tiny model): resident-session capacity at the
+# dense-equivalent HBM budget, warm-prefix TTFT zero-copy page mapping vs
+# the PR-2 compiled fork, and the steady-ITL regression guard on the
+# gather/scatter attention path; writes BENCH_paged.json
+paged:
+	JAX_PLATFORMS=cpu $(PY) scripts/bench_paged.py
 
 # chaos soak: live daemon + engine subprocesses through the seeded fault
 # schedule (store blips, SIGKILLs, slow dispatch, torn AOF, poisoned

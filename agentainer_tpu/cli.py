@@ -116,13 +116,23 @@ def cmd_deploy(args) -> None:
             print(f"  {line}")
         print(f"built artifact {art['name']!r}")
         model = {"engine": "llm", "artifact": art["name"]}
+    # engine-option flags need the dict form of the model spec; normalize
+    # a bare "engine:config" string once, then each flag just sets options
+    option_overrides: dict[str, object] = {}
     if getattr(args, "no_speculative", False):
         # A/B baseline deploy: pin this agent's engine to the plain decode
         # path (options.speculative=false, same channel the deploy YAML uses)
+        option_overrides["speculative"] = False
+    if getattr(args, "paged_kv", False) or getattr(args, "no_paged_kv", False):
+        # paged KV arena per deployment: --paged-kv opts in (pool-bounded
+        # resident sessions), --no-paged-kv pins the dense A/B baseline
+        # even when the fleet default (features.paged_kv) flips on
+        option_overrides["paged_kv"] = bool(getattr(args, "paged_kv", False))
+    if option_overrides:
         if isinstance(model, str):
             engine, _, config = model.partition(":")
             model = {"engine": engine or "echo", "config": config}
-        model.setdefault("options", {})["speculative"] = False
+        model.setdefault("options", {}).update(option_overrides)
     body = {
         "name": args.name,
         "model": model,
@@ -410,6 +420,21 @@ def build_parser() -> argparse.ArgumentParser:
         help="disable self-speculative decoding for this agent's engine "
         "(the plain-decode A/B baseline; same as options.speculative: false "
         "in a deployment YAML)",
+    )
+    paged_group = s.add_mutually_exclusive_group()
+    paged_group.add_argument(
+        "--paged-kv",
+        action="store_true",
+        help="serve this agent's engine from the paged KV arena (block "
+        "tables: resident sessions bounded by the page pool instead of "
+        "max_batch, zero-copy prefix sharing; same as options.paged_kv: "
+        "true in a deployment YAML)",
+    )
+    paged_group.add_argument(
+        "--no-paged-kv",
+        action="store_true",
+        help="pin this agent's engine to the dense KV arena (the A/B "
+        "baseline) even when the fleet default features.paged_kv is on",
     )
     s.add_argument("--health-endpoint", default="")
     s.add_argument("--health-interval", type=float, default=30.0)

@@ -44,6 +44,12 @@ class FeatureFlags:
     # + batched verify). Per-deployment model options override; false here
     # pins the whole fleet to the plain decode path (the A/B baseline).
     speculative: bool = True
+    # Default for engines' paged KV arena (block tables: pool-bounded
+    # resident sessions, zero-copy prefix sharing, page-tail speculative
+    # rewind). Off by default while the dense arena remains the
+    # hardware-burned-in baseline; per-deployment model options override
+    # (same plumbing pattern as ``speculative``).
+    paged_kv: bool = False
 
 
 @dataclass
@@ -274,6 +280,13 @@ def load_config(path: str | None = None) -> Config:
     )
     if "ATPU_SPECULATIVE" in env:
         cfg.features.speculative = env["ATPU_SPECULATIVE"].lower() in (
+            "1",
+            "true",
+            "yes",
+        )
+    cfg.features.paged_kv = bool(feats.get("paged_kv", cfg.features.paged_kv))
+    if "ATPU_PAGED_KV" in env:
+        cfg.features.paged_kv = env["ATPU_PAGED_KV"].lower() in (
             "1",
             "true",
             "yes",

@@ -70,6 +70,10 @@ def build_services(
     # the flag, so this is a write-back of the resolved value — a second
     # build_services with a different config must not inherit a stale latch
     os.environ["ATPU_SPECULATIVE"] = "1" if config.features.speculative else "0"
+    # same write-back discipline for the paged-KV arena default: every
+    # spawned engine inherits the fleet's resolved choice unless its own
+    # deployment options say otherwise
+    os.environ["ATPU_PAGED_KV"] = "1" if config.features.paged_kv else "0"
     # Fault plane: the registry and the ATPU_FAULTS env the engines inherit
     # always reflect THIS config's schedule — same write-back-the-resolved-
     # value discipline as ATPU_SPECULATIVE above: an empty spec must clear a

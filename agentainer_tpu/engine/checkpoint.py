@@ -59,7 +59,13 @@ def load_params(cfg: ModelConfig, path: str | Path, dtype=jnp.bfloat16) -> dict:
 # resume guarantee under near-tie greedy argmax). bfloat16 has no portable
 # npz encoding (np.savez degrades it to a void dtype), so it travels as a
 # uint16 bit-view with the true dtype recorded in the header.
-SNAP_VERSION = 2
+# v3: paged-arena era. The payload layout is UNCHANGED (position-trimmed
+# [L, pos, KV, hd] prefix in the exact dtype) — a paged engine stages it
+# by gathering only the session's live pages, and the optional
+# ``page_size`` header records that provenance — so v3 blobs restore into
+# paged and dense engines alike, and v2/v1 blobs written before the
+# upgrade keep restoring (the reader accepts all three).
+SNAP_VERSION = 3
 
 
 def pack_kv_snapshot(k16, v16, position: int, meta: dict | None = None) -> bytes:
@@ -96,7 +102,7 @@ def deserialize_kv_slot(blob: bytes) -> tuple[np.ndarray, np.ndarray, dict]:
         k, v = z["k"], z["v"]
         if version == 1:
             return k, v, header  # legacy: fp16 as stored
-        if version != SNAP_VERSION:
+        if version not in (2, SNAP_VERSION):  # v2 fallback: same payload layout
             raise ValueError(f"unsupported KV snapshot version: {version}")
         if header.get("dtype") == "bfloat16":
             import ml_dtypes

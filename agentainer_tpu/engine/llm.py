@@ -41,11 +41,17 @@ from jax import lax
 
 from .. import faults
 from ..models.configs import ModelConfig, get_config
-from ..models.llama import KVCache, forward, init_params
+from ..models.llama import KVCache, PagedKVCache, forward, init_params
 from .sampling import NEG_INF, sample
 from .tokenizer import load_tokenizer
 
 PREFILL_BUCKETS = (32, 64, 128, 256, 512, 1024)
+
+# Paged KV arena (block tables): the pool's page granularity in tokens.
+# 64 keeps every PREFILL_BUCKET level ≥ 64 page-aligned (zero-copy prefix
+# sharing with no partial tail) while a near-empty session pins one page,
+# not a whole max_seq slot.
+PAGE_SIZE_DEFAULT = 64
 
 # Self-speculative decoding (prompt-lookup drafting + batched multi-token
 # verification). The verify ladder mirrors the decode-chunk ladder: one
@@ -105,6 +111,20 @@ class EngineOverloaded(RuntimeError):
         self.depth = depth
         self.watermark = watermark
         self.retry_after_s = retry_after_s
+
+
+class PagePoolExhausted(EngineOverloaded):
+    """Paged-arena allocation failed even after evicting idle residents:
+    the pool is genuinely full of in-flight + pinned pages. A POLICY
+    backpressure signal, not a fault — subclasses EngineOverloaded so the
+    serve layer maps it to 429 + Retry-After and the journal keeps the
+    entry replayable (no acked loss)."""
+
+    def __init__(self, need: int, free: int):
+        super().__init__(depth=need, watermark=free)
+        self.args = (
+            f"KV page pool exhausted: need {need} page(s), {free} free",
+        )
 
 
 class EngineDraining(RuntimeError):
@@ -199,6 +219,46 @@ class PrefixEntry:
     created: float
     last_used: float
     hits: int = 0
+    # paged arena: instead of private k/v buffers the entry PINS pool
+    # pages (refcounted, read-only) — zero-copy registration and forking.
+    # A non-page-aligned level additionally owns one copied tail page
+    # holding the partial last page (``tail_len`` live tokens).
+    pages: list[int] | None = None
+    tail_page: int | None = None
+    tail_len: int = 0
+
+
+@dataclass
+class PagedSession:
+    """A resident session in the paged arena: its KV lives in ``pages``
+    (physical page ids, logical order), NOT in a lane — so a session
+    between turns holds only its pages' HBM and zero compute lanes, and
+    residency is bounded by the pool, not ``max_batch``. ``pages[:shared]``
+    are refcount-shared prefix pages mapped read-only (the session never
+    writes below its fork point, so sharing needs no guard beyond the
+    partial-tail copy-on-write done at fork time)."""
+
+    name: str
+    pages: list[int] = field(default_factory=list)
+    shared: int = 0
+    position: int = 0
+    pending_token: int | None = None
+    # bound compute lane while a request is in flight; None between turns
+    lane: int | None = None
+    last_used: float = 0.0
+    # admission-time pending token AND position, kept so a pool-exhaustion
+    # failure can roll the session back to its pre-request state instead of
+    # dropping it (position advances mid-request: the prefix map sets it at
+    # admission and every speculative accept syncs it — neither belongs to
+    # a request that ultimately failed with 429)
+    admit_pending: int | None = None
+    admit_position: int = 0
+    admit_spec_hist: list[int] = field(default_factory=list)
+    # self-speculation state persists across turns WITH the session (the
+    # lane mirrors it while bound and syncs back at finish)
+    spec_hist: list[int] = field(default_factory=list)
+    spec_ema: float = 1.0
+    spec_miss: int = 0
 
 
 @dataclass
@@ -239,6 +299,9 @@ class Slot:
     spec_ema: float = 1.0
     spec_miss: int = 0
     spec_probe_at: int = -(10**9)
+    # paged arena: the PagedSession bound to this lane while a request is
+    # in flight (None in dense mode and between turns)
+    psess: PagedSession | None = None
 
 
 class LLMEngine:
@@ -266,6 +329,9 @@ class LLMEngine:
         shed_watermark: int = 0,
         speculative: bool = True,
         spec_gamma_max: int = 8,
+        paged_kv: bool = False,
+        page_size: int = PAGE_SIZE_DEFAULT,
+        kv_pages: int = 0,
     ):
         self.cfg = cfg
         self.tokenizer = tokenizer
@@ -274,7 +340,40 @@ class LLMEngine:
         self.sp = max(1, sp)
         # the sequence axis must split evenly over sp chips
         max_seq = ((max_seq + self.sp - 1) // self.sp) * self.sp
+        # Paged KV arena (block tables): sessions hold lists of fixed-size
+        # pages from a global pool instead of dense [max_seq] slots, so
+        # resident sessions are bounded by the pool, prefix sharing maps
+        # refcounted pages zero-copy, and speculative rewind truncates page
+        # tails. paged_kv=False keeps the dense arena — the A/B baseline
+        # (mirrors adaptive_decode / prefix_cache / speculative). sp stages
+        # the SEQUENCE axis across chips and pp stages the cache over
+        # layers with its own alloc path — neither composes with the page
+        # pool yet, so they pin the dense arena.
+        self.paged = bool(paged_kv) and self.sp == 1 and self.pp == 1
+        if bool(paged_kv) and not self.paged:
+            print(
+                "[llm-engine] paged_kv disabled: not composable with "
+                f"sp={self.sp}/pp={self.pp} yet (dense arena retained)",
+                flush=True,
+            )
+        self.page_size = max(8, int(page_size or PAGE_SIZE_DEFAULT))
+        if self.paged:
+            # the logical arena must tile exactly into pages
+            max_seq = (
+                (max_seq + self.page_size - 1) // self.page_size
+            ) * self.page_size
         self.max_seq = max_seq
+        # pages per full logical sequence (the block-table width)
+        self._n_blocks = max(1, self.max_seq // self.page_size)
+        # pool sizing: default matches the dense arena's HBM exactly
+        # (max_batch × max_seq tokens of KV) so paged-vs-dense capacity is
+        # an apples-to-apples A/B at unchanged budget; +max_batch dedicated
+        # scratch pages (one per lane) absorb parked-lane and padding
+        # writes without ever touching a session's pages
+        self._data_pages = (
+            max(1, int(kv_pages)) if kv_pages else max_batch * self._n_blocks
+        )
+        self._total_pages = self._data_pages + max_batch
         self.decode_chunk = max(1, decode_chunk)
         # Adaptive decode-chunk policy (admission-aware scheduling): a small
         # ladder of kernel-looped chunk sizes is compiled at warmup; the
@@ -308,7 +407,19 @@ class LLMEngine:
         self.moe_capacity_factor = float(moe_capacity_factor)
         self.scratch_pos = max_seq - 1  # idle-slot write target; never generated into
         dtype = params["final_norm"].dtype  # always dense, even when quantized
-        cache_shape = (cfg.n_layers, max_batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
+        if self.paged:
+            # page pool [L, P, page_size, KV, hd]: same two-leaf pytree
+            # discipline as the dense arena, so scan/donation/sharding
+            # machinery applies unchanged
+            cache_shape = (
+                cfg.n_layers,
+                self._total_pages,
+                self.page_size,
+                cfg.n_kv_heads,
+                cfg.head_dim,
+            )
+        else:
+            cache_shape = (cfg.n_layers, max_batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
         self._pp_forward = None
         if self.pp > 1:
             # serve-time pipeline: layer stack AND the KV arena stage over
@@ -371,13 +482,28 @@ class LLMEngine:
             # quant-aware: int8 QTensor leaves shard q on the dense spec and
             # replicate the scale across the contraction split
             params = jax.device_put(params, param_shardings_for(params, self.mesh, cfg.is_moe))
-            cache_sh = NamedSharding(self.mesh, cache_specs(sp=self.sp > 1))
-            self._alloc_cache = jax.jit(
-                lambda: KVCache(
-                    jnp.zeros(cache_shape, dtype), jnp.zeros(cache_shape, dtype)
-                ),
-                out_shardings=KVCache(cache_sh, cache_sh),
-            )
+            if self.paged:
+                # pool shards on the KV-head axis exactly like the dense
+                # arena; the page axis stays whole (page ids are global —
+                # the block-table gather must be shard-local, pinned by
+                # tests/test_paged_hlo.py)
+                from jax.sharding import PartitionSpec as _P
+
+                cache_sh = NamedSharding(self.mesh, _P(None, None, None, "tp", None))
+                self._alloc_cache = jax.jit(
+                    lambda: PagedKVCache(
+                        jnp.zeros(cache_shape, dtype), jnp.zeros(cache_shape, dtype)
+                    ),
+                    out_shardings=PagedKVCache(cache_sh, cache_sh),
+                )
+            else:
+                cache_sh = NamedSharding(self.mesh, cache_specs(sp=self.sp > 1))
+                self._alloc_cache = jax.jit(
+                    lambda: KVCache(
+                        jnp.zeros(cache_shape, dtype), jnp.zeros(cache_shape, dtype)
+                    ),
+                    out_shardings=KVCache(cache_sh, cache_sh),
+                )
             cache = self._alloc_cache()
         else:
             self.mesh = None
@@ -391,17 +517,59 @@ class LLMEngine:
             dev = devices[0] if devices else jax.devices()[0]
             params = jax.device_put(params, dev)  # checkpoint loads arrive host-side
 
-            def _alloc_single() -> KVCache:
-                with jax.default_device(dev):
-                    c = KVCache.create(cfg, max_batch, max_seq, dtype=dtype)
-                return jax.device_put(c, dev)
+            if self.paged:
+
+                def _alloc_single():
+                    with jax.default_device(dev):
+                        c = PagedKVCache.create(
+                            cfg, self._total_pages, self.page_size, dtype=dtype
+                        )
+                    return jax.device_put(c, dev)
+
+            else:
+
+                def _alloc_single():
+                    with jax.default_device(dev):
+                        c = KVCache.create(cfg, max_batch, max_seq, dtype=dtype)
+                    return jax.device_put(c, dev)
 
             self._alloc_cache = _alloc_single
             cache = self._alloc_cache()
         self.params = params
         self.cache = cache
         self.slots = [Slot(i) for i in range(max_batch)]
+        # session membership surface. Dense: name → owning slot index (the
+        # slot holds the KV). Paged: name → bound lane index while a
+        # request is in flight, -1 while resident-but-idle — membership
+        # and iteration keep working for the serve layer (restore checks,
+        # drain snapshots), but the KV lives in paged_sessions[name].pages.
         self.sessions: dict[str, int] = {}
+        # -- paged-arena allocator (host side; _page_lock guards it) ------
+        # physical ids [0, _data_pages) are allocatable; ids [_data_pages,
+        # _total_pages) are per-lane scratch pages (lane i owns id
+        # _data_pages + i), permanently pinned, never shared: parked-lane
+        # and bucket-padding writes land there instead of in any session's
+        # pages. The authoritative block table is HOST state (numpy) and
+        # ships to the device per dispatch — ~1 KB, async, and never a
+        # recompile since it is an argument, not a constant.
+        self.paged_sessions: dict[str, PagedSession] = {}
+        self._page_lock = threading.RLock()
+        self._page_free: list[int] = list(range(self._data_pages - 1, -1, -1))
+        self._page_refs = np.zeros(self._total_pages, dtype=np.int64)
+        # pages freed while readbacks are in flight park here: a chunk
+        # dispatched BEFORE the free captured the old block table and will
+        # still write into these pages — they must not be reallocated until
+        # that dispatch's readback has drained
+        self._page_quarantine: list[int] = []
+        self._bt = np.empty((max_batch, self._n_blocks), dtype=np.int32)
+        for i in range(max_batch):
+            self._bt[i, :] = self._scratch_page(i)
+        self.page_exhausted_total = 0
+        self.pages_truncated = 0
+        self.prefix_pages_shared = 0
+        self._snap_paged_fns: dict[int, Any] = {}
+        self._restore_paged_fns: dict[int, Any] = {}
+        self._page_copy_fn_cached: Any = None
 
         # Device-side decode carry: the pipelined decode chains (token,
         # position, temperature) per slot lane ON DEVICE across chunks, so
@@ -545,10 +713,16 @@ class LLMEngine:
         )
         self._prefix_bytes = 0
         # arena budget defaults to the main KV arena's size: one extra
-        # arena's worth of HBM buys ~every repeat prefill in the workload
-        self._prefix_budget = (
-            int(prefix_cache_bytes) if prefix_cache_bytes else self.kv_arena_bytes
-        )
+        # arena's worth of HBM buys ~every repeat prefill in the workload.
+        # Paged engines pin prefix pages INSIDE the pool (no extra HBM), so
+        # the default caps pinning at half the pool — the other half stays
+        # for live sessions; pool pressure can still evict pinned entries.
+        if prefix_cache_bytes:
+            self._prefix_budget = int(prefix_cache_bytes)
+        elif self.paged:
+            self._prefix_budget = self.kv_arena_bytes // 2
+        else:
+            self._prefix_budget = self.kv_arena_bytes
         self._prefix_slice_fns: dict[int, Any] = {}
         self._prefix_fork_fns: dict[int, Any] = {}
         self.prefix_hits = 0
@@ -710,6 +884,9 @@ class LLMEngine:
                 shed_watermark=int(options.get("shed_watermark", 0) or 0),
                 speculative=bool(options.get("speculative", True)),
                 spec_gamma_max=int(options.get("spec_gamma_max", 8) or 8),
+                paged_kv=bool(options.get("paged_kv", False)),
+                page_size=int(options.get("page_size", PAGE_SIZE_DEFAULT) or PAGE_SIZE_DEFAULT),
+                kv_pages=int(options.get("kv_pages", 0) or 0),
             )
             if not options.get("skip_warmup"):
                 engine.warmup()
@@ -835,6 +1012,9 @@ class LLMEngine:
             shed_watermark=int(options.get("shed_watermark", 0) or 0),
             speculative=bool(options.get("speculative", True)),
             spec_gamma_max=int(options.get("spec_gamma_max", 8) or 8),
+            paged_kv=bool(options.get("paged_kv", False)),
+            page_size=int(options.get("page_size", PAGE_SIZE_DEFAULT) or PAGE_SIZE_DEFAULT),
+            kv_pages=int(options.get("kv_pages", 0) or 0),
         )
         # pay the decode/prefill compiles here (inside the loader thread, while
         # /health keeps answering) instead of on the first user request.
@@ -855,7 +1035,7 @@ class LLMEngine:
         # body (parallel/flash_mesh.py). sp-sharded arenas stay on the
         # einsum path (they need the partial-softmax combine XLA derives).
         cache_attn_impl = None
-        if self.mesh is not None and self.sp == 1 and self.pp == 1:
+        if self.mesh is not None and self.sp == 1 and self.pp == 1 and not self.paged:
             from ..parallel.flash_mesh import make_meshed_cache_attention, resolve_mesh_flash
 
             interp = resolve_mesh_flash(cfg, self.tp)
@@ -885,7 +1065,7 @@ class LLMEngine:
 
         pp_forward = self._pp_forward
 
-        def run_forward(params, toks, pos, cache):
+        def run_forward(params, toks, pos, cache, bt=None):
             if pp_forward is not None:
                 logits, k, v = pp_forward(params, toks, pos, cache.k, cache.v)
                 return logits, KVCache(k, v)
@@ -898,7 +1078,12 @@ class LLMEngine:
                 use_flash=use_flash,
                 cache_attn_impl=cache_attn_impl,
                 moe_impl=moe_impl,
+                block_table=bt,
             )
+
+        # the paged fns can't read the logical arena length off the cache
+        # (its page axis is pool-wide); close over it statically
+        scratch_static = self.max_seq - 1
 
         def prefill(params, cache, slot, tokens, positions, n_real):
             # slice the slot's cache row, run the prompt, write the row back
@@ -910,7 +1095,14 @@ class LLMEngine:
             last = lax.dynamic_slice_in_dim(logits, n_real - 1, 1, axis=1)[0, 0]
             return last, KVCache(newk, newv)
 
-        def decode_n(params, cache, tokens, positions, temps, keys):
+        def prefill_paged(params, cache, bt, tokens, positions, n_real):
+            # no row slice/write-back: the lane's single-row block table IS
+            # the view, and writes land in pool pages directly
+            logits, cache = run_forward(params, tokens, positions, cache, bt)
+            last = lax.dynamic_slice_in_dim(logits, n_real - 1, 1, axis=1)[0, 0]
+            return last, cache
+
+        def decode_n(params, cache, tokens, positions, temps, keys, bt=None):
             """Kernel-looped decode: ``chunk`` autoregressive steps inside one
             compiled call (lax.scan), so the host↔device round trip is paid
             once per chunk, not once per token. The (token, position) carry
@@ -918,13 +1110,17 @@ class LLMEngine:
             worker never has to wait for tokens to cross the host boundary
             between chunks. Tokens a request doesn't end up using are rolled
             back by the worker (their cache writes are overwritten before any
-            later query can attend to them)."""
+            later query can attend to them). One body serves both arenas:
+            with ``bt`` the cache is the page pool (block table constant
+            across the chunk — the dispatcher pre-allocates every step's
+            pages) and the scratch clamp comes from the engine statics,
+            since the pool's page axis says nothing about logical length."""
 
-            scratch = cache.k.shape[2] - 1
+            scratch = cache.k.shape[2] - 1 if bt is None else scratch_static
 
             def step(carry, key):
                 tok, pos, cache = carry
-                logits, cache = run_forward(params, tok[:, None], pos[:, None], cache)
+                logits, cache = run_forward(params, tok[:, None], pos[:, None], cache, bt)
                 nxt = sample(logits[:, 0], key, temperature=temps)
                 # clamp: parked (idle/finished) lanes decode forever at the
                 # scratch position — real lanes never reach it (admission
@@ -933,6 +1129,11 @@ class LLMEngine:
 
             (tok, pos, cache), toks = lax.scan(step, (tokens, positions, cache), keys)
             return toks, tok, pos, cache  # toks [chunk, B]
+
+        def decode_n_paged(params, cache, bt, tokens, positions, temps, keys):
+            # positional-arg adapter for the call-site splat (bt sits
+            # between cache and the token state); the body is decode_n
+            return decode_n(params, cache, tokens, positions, temps, keys, bt)
 
         def inject(tok, pos, temps, idx, first, position, temp):
             """Point a slot's decode lane at its prefill result: lane `idx`
@@ -945,8 +1146,12 @@ class LLMEngine:
                 temps.at[idx].set(temp),
             )
 
-        self._prefill = jax.jit(prefill, donate_argnums=(1,))
-        self._decode_n = jax.jit(decode_n, donate_argnums=(1, 2, 3))
+        if self.paged:
+            self._prefill = jax.jit(prefill_paged, donate_argnums=(1,))
+            self._decode_n = jax.jit(decode_n_paged, donate_argnums=(1, 3, 4))
+        else:
+            self._prefill = jax.jit(prefill, donate_argnums=(1,))
+            self._decode_n = jax.jit(decode_n, donate_argnums=(1, 2, 3))
         self._inject = jax.jit(inject, donate_argnums=(0, 1, 2))
         # the verify ladder reuses the same forward (one prefill-shaped call
         # with t = k+1 per round); fns are built per bucket on demand and
@@ -1041,21 +1246,39 @@ class LLMEngine:
         # land on the serving worker thread mid-traffic, stalling every
         # in-flight decode for the compile's duration — tens of seconds on
         # a tunneled chip, which 502'd the round-4 flagship bench run
-        b = PREFILL_BUCKETS[0]
-        snap_buckets = set()
-        while True:
-            snap_buckets.add(min(b, self.max_seq))
-            if b >= self.max_seq:
-                break
-            b *= 2
-        for bucket in sorted(snap_buckets):
-            jax.block_until_ready(self._snap_fn(bucket)(self.cache, jnp.int32(0)))
+        if self.paged:
+            # paged snapshot stagers: exact-page-count gathers, warmed at
+            # pow2 counts (odd counts compile on demand — a trivial gather)
+            c = 1
+            while True:
+                count = min(c, self._n_blocks)
+                ids = jnp.zeros((count,), jnp.int32)
+                jax.block_until_ready(self._snap_fn_paged(count)(self.cache, ids))
+                if c >= self._n_blocks:
+                    break
+                c *= 2
+        else:
+            b = PREFILL_BUCKETS[0]
+            snap_buckets = set()
+            while True:
+                snap_buckets.add(min(b, self.max_seq))
+                if b >= self.max_seq:
+                    break
+                b *= 2
+            for bucket in sorted(snap_buckets):
+                jax.block_until_ready(self._snap_fn(bucket)(self.cache, jnp.int32(0)))
         # prefix-arena copy fns (same warm-up pattern as the snapshot
         # slicers): one slice + one fork executable per bucket level, so an
         # admission-time fork never pays a serve-time compile. The fork
         # round-trips slot 0's own rows — it writes back exactly what it
-        # read, so warmed state is untouched.
-        if self.prefix_cache:
+        # read, so warmed state is untouched. Paged engines fork by PAGE
+        # MAPPING (no compiled copy at all); only the partial-tail CoW
+        # single-page copy needs warming.
+        if self.prefix_cache and self.paged:
+            scr = jnp.int32(self._scratch_page(0))
+            self.cache = self._page_copy_fn()(self.cache, scr, scr)
+            jax.block_until_ready(self.cache.k)
+        elif self.prefix_cache:
             for b in self._prefix_levels:
                 k, v = self._prefix_slice_fn(b)(self.cache, jnp.int32(0))
                 self.cache = self._prefix_fork_fn(b)(
@@ -1073,6 +1296,7 @@ class LLMEngine:
                 _, _, self._dtok, self._dpos, self.cache = self._verify_fn(b)(
                     self.params,
                     self.cache,
+                    *self._bt_arg(),
                     self._dtok,
                     self._dpos,
                     self._dtemps,
@@ -1107,6 +1331,14 @@ class LLMEngine:
         self.flops_done = 0.0
         self.hbm_bytes_read = 0.0
         self._last_decode_end = None
+        if self.paged:
+            # warmup's anonymous sessions already freed their pages at
+            # finish; reclaim anything still quarantined and zero the
+            # pool-telemetry counters so serving starts from a clean gauge
+            self._release_quarantine()
+            self.page_exhausted_total = 0
+            self.pages_truncated = 0
+            self.prefix_pages_shared = 0
         self._started_at = time.monotonic()
 
     # -- public API (called from the aiohttp loop) ------------------------
@@ -1238,18 +1470,40 @@ class LLMEngine:
         k16, v16, position, pending_token = staged
         from .checkpoint import pack_kv_snapshot
 
+        meta = {"session": session, "pending_token": pending_token}
+        if self.paged:
+            # staged from live pages only (ceil(position/page_size) pages,
+            # not a pow2 position bucket); payload layout is identical to
+            # the dense staging so blobs restore across both arenas
+            meta["page_size"] = self.page_size
         return await asyncio.to_thread(
             pack_kv_snapshot,
             k16,
             v16,
             position,
-            {"session": session, "pending_token": pending_token},
+            meta,
         )
 
     def _do_snapshot(self, cmd: SnapshotCmd) -> None:
         """Worker-thread half of snapshot_session: dispatch the bucketed
         slice (async on the device queue) and hand the staged buffers to the
         caller. No blocking readback here — decode keeps flowing."""
+        if self.paged:
+            sess = self.paged_sessions.get(cmd.session)
+            if sess is None:
+                cmd.loop.call_soon_threadsafe(_resolve_value, cmd.future, None)
+                return
+            self._snap_last_by_session.setdefault(cmd.session, time.monotonic())
+            if sess.lane is not None and self.slots[sess.lane].request is not None:
+                if cmd.session in self._snap_parked:
+                    cmd.loop.call_soon_threadsafe(
+                        _resolve_value, cmd.future, "rate-limited"
+                    )
+                else:
+                    self._snap_parked[cmd.session] = cmd
+                return
+            self._stage_snapshot_paged(cmd, sess)
+            return
         idx = self.sessions.get(cmd.session)
         if idx is None:
             cmd.loop.call_soon_threadsafe(_resolve_value, cmd.future, None)
@@ -1273,27 +1527,33 @@ class LLMEngine:
             return
         self._stage_snapshot(cmd, slot)
 
-    def _stage_snapshot(self, cmd: SnapshotCmd, slot: Slot) -> None:
-        """Stage a settled slot's prefix (worker thread). Applies the global
-        gap/force limiter: a snapshot's device→host readback serializes
-        with decode on the device link (measured ~1.25s for an 8B
-        bucket-128 blob over the tunnel), so stagings are spaced out."""
-        staged = None
+    def _snap_gate(self, session: str) -> bool:
+        """Shared staging limiter (dense slot and paged session alike):
+        True = rate-limited this time. A snapshot's device→host readback
+        serializes with decode on the device link (measured ~1.25s for an
+        8B bucket-128 blob over the tunnel), so stagings are spaced out;
+        the per-session durability floor forces one through eventually."""
         now = time.monotonic()
         busy = any(s.decoding or s.pending_prompt for s in self.slots)
         # durability floor is PER SESSION: with a global timer, whichever
         # session staged first reset it for everyone and the other sessions
         # starved for N×30s under sustained multi-session load
-        session_last = self._snap_last_by_session.get(cmd.session, self._snap_epoch0)
+        session_last = self._snap_last_by_session.get(session, self._snap_epoch0)
         overdue = now - session_last >= self.snapshot_force_s
         # busy stagings are spaced wider: each one costs ~a second of device
         # link the in-flight generations are using, so under sustained load
         # the per-session floor degrades gracefully to ~n_sessions×busy_gap
         gap = self.snapshot_busy_gap_s if busy else self.snapshot_min_gap_s
         gap_ok = now - self._last_snapshot_at >= gap
-        if not gap_ok or (busy and not overdue):
+        return (not gap_ok) or (busy and not overdue)
+
+    def _stage_snapshot(self, cmd: SnapshotCmd, slot: Slot) -> None:
+        """Stage a settled slot's prefix (worker thread), limiter-gated."""
+        staged = None
+        if self._snap_gate(cmd.session):
             staged = "rate-limited"
         elif slot.position > 0:
+            now = time.monotonic()
             self._last_snapshot_at = now
             self._snap_last_by_session[cmd.session] = now
             k16, v16 = self._snap_fn(self._snap_bucket(slot.position))(
@@ -1307,12 +1567,39 @@ class LLMEngine:
             staged = (k16, v16, slot.position, slot.pending_token)
         cmd.loop.call_soon_threadsafe(_resolve_value, cmd.future, staged)
 
+    def _stage_snapshot_paged(self, cmd: SnapshotCmd, sess: PagedSession) -> None:
+        """Paged staging: gather ONLY the session's live pages into a
+        contiguous buffer — a 100-token session ships 2 pages, not a pow2
+        position bucket — same limiter, same exact-dtype discipline."""
+        staged = None
+        if self._snap_gate(cmd.session):
+            staged = "rate-limited"
+        elif sess.position > 0 and sess.pages:
+            now = time.monotonic()
+            self._last_snapshot_at = now
+            self._snap_last_by_session[cmd.session] = now
+            count = min(
+                len(sess.pages), (sess.position - 1) // self.page_size + 1
+            )
+            ids = jnp.asarray(np.asarray(sess.pages[:count], dtype=np.int32))
+            k16, v16 = self._snap_fn_paged(count)(self.cache, ids)
+            try:
+                k16.copy_to_host_async()
+                v16.copy_to_host_async()
+            except Exception:
+                pass
+            staged = (k16, v16, sess.position, sess.pending_token)
+        cmd.loop.call_soon_threadsafe(_resolve_value, cmd.future, staged)
+
     def _service_parked_snapshot(self, slot: Slot) -> None:
         """Called at a request's finish: stage any snapshot parked on this
         session while the slot is provably idle."""
         cmd = self._snap_parked.pop(slot.session, None) if slot.session else None
         if cmd is not None:
-            self._stage_snapshot(cmd, slot)
+            if self.paged and slot.psess is not None:
+                self._stage_snapshot_paged(cmd, slot.psess)
+            else:
+                self._stage_snapshot(cmd, slot)
 
     def _flush_parked_snapshot(self, session: str) -> None:
         """Session going away (eviction/reset/clear): a parked snapshot
@@ -1349,6 +1636,350 @@ class LLMEngine:
                 return k, v
 
             fn = self._snap_fns[bucket] = jax.jit(_snap)
+        return fn
+
+    # -- paged arena: page allocator + block tables -----------------------
+    #
+    # Host-side bookkeeping for the device page pool. The free list /
+    # refcounts / block table live in numpy under _page_lock (the worker
+    # allocates; API threads clear sessions), and the table ships to the
+    # device as a per-dispatch argument. Refcounting is what makes prefix
+    # sharing zero-copy: a cached prefix PINS its pages, sessions map them
+    # read-only (they never write below their fork point), and a page is
+    # returned to the free list only when its last reference drops.
+
+    def _scratch_page(self, lane: int) -> int:
+        """Lane ``lane``'s dedicated scratch page: every block-table entry
+        not covered by the bound session's pages points here, so parked
+        decode steps and bucket-padding writes land in per-lane garbage
+        that no live query's position mask ever exposes."""
+        return self._data_pages + lane
+
+    def _bt_arg(self) -> tuple:
+        """The block-table positional argument the paged compiled fns take
+        between ``cache`` and the token state — empty in dense mode, so
+        shared call sites splat it instead of duplicating argument lists."""
+        return (jnp.asarray(self._bt),) if self.paged else ()
+
+    def _alloc_pages(
+        self, n: int, serving: bool = True, reclaim: bool = True
+    ) -> list[int]:
+        """Take ``n`` pages off the free list, evicting idle resident
+        sessions (then unpinning prefix entries) LRU-first when the list
+        runs dry. Raises PagePoolExhausted — mapped to 429 backpressure by
+        the serve layer — when reclaim cannot cover the need; the pool
+        being full of in-flight work is overload, not a fault."""
+        if n <= 0:
+            return []
+        if serving:
+            # failpoint: deterministic pool-exhaustion injection (chaos
+            # soak). Any injected error surfaces as the same backpressure
+            # a genuinely full pool produces — never a crash.
+            try:
+                faults.fire("engine.page_alloc")
+            except Exception as e:
+                self.page_exhausted_total += 1
+                with self._page_lock:
+                    free = len(self._page_free)
+                raise PagePoolExhausted(n, free) from e
+        self._reap_quarantine_if_short(n)
+        with self._page_lock:
+            if len(self._page_free) < n and reclaim:
+                self._reclaim_pages(n)
+        # eviction frees land in quarantine while readbacks are in flight;
+        # take them back before declaring exhaustion
+        self._reap_quarantine_if_short(n)
+        with self._page_lock:
+            if len(self._page_free) < n:
+                if serving:
+                    # only SERVING allocations are backpressure events: a
+                    # best-effort internal alloc (prefix tail pin) failing
+                    # must not inflate the 429 evidence counter
+                    self.page_exhausted_total += 1
+                raise PagePoolExhausted(n, len(self._page_free))
+            ids = [self._page_free.pop() for _ in range(n)]
+            for pid in ids:
+                self._page_refs[pid] = 1
+            return ids
+
+    def _reclaim_pages(self, need: int) -> None:
+        """Evict until ``need`` pages are free (or nothing evictable is
+        left): idle resident sessions LRU-first — they can re-prefill (or
+        restore from their store snapshot) — then prefix-arena pins, which
+        only cost the next cold prefill. In-flight sessions are never
+        touched. Caller holds _page_lock. Quarantined pages COUNT toward
+        the goal (the caller reaps them right after): with readbacks in
+        flight every eviction's pages land in quarantine, and a loop
+        watching only the free list would keep evicting — one transient
+        one-page shortfall wiping every idle resident and prefix pin."""
+
+        def short() -> bool:
+            return len(self._page_free) + len(self._page_quarantine) < need
+
+        while short():
+            victim = None
+            for sess in self.paged_sessions.values():
+                if sess.lane is not None or not sess.pages:
+                    continue
+                if victim is None or sess.last_used < victim.last_used:
+                    victim = sess
+            if victim is None:
+                break
+            self._count_eviction("session", time.monotonic() - victim.last_used)
+            self._free_session_pages(victim)
+            self.paged_sessions.pop(victim.name, None)
+            self.sessions.pop(victim.name, None)
+            self._flush_parked_snapshot(victim.name)
+        now = time.monotonic()
+        while short() and any(
+            e.pages is not None for e in self._prefix_entries.values()
+        ):
+            self._prefix_evict_lru(now)
+
+    def _free_page_ids(self, ids: list[int]) -> None:
+        """Return zero-ref pages to the free list — via quarantine when
+        readbacks are in flight: a chunk dispatched before the free holds
+        the OLD device block table and will still write into these pages,
+        so reallocating them before its readback drains would let parked
+        garbage corrupt another session's KV."""
+        if not ids:
+            return
+        with self._page_lock:
+            if self._readbacks:
+                self._page_quarantine.extend(ids)
+            else:
+                self._page_free.extend(ids)
+
+    def _reap_quarantine_if_short(self, need: int) -> None:
+        """Allocation-path quarantine release (worker thread): when the
+        free list can't cover ``need``, WAIT for the in-flight device work
+        to finish — NOT for the readback FIFO to process. Draining the
+        FIFO here would run admissions and finishes in the middle of a
+        dispatch whose lane snapshot the caller already captured, desyncing
+        token delivery. Every cache-writing dispatch chains through the
+        donated pool (self.cache is the newest link), so the current
+        cache being ready proves every stale-block-table write has landed
+        and the whole quarantine is reallocatable. Token readbacks still
+        pending in the FIFO are independent device arrays — releasing the
+        pages under them is safe."""
+        with self._page_lock:
+            if len(self._page_free) >= need or not self._page_quarantine:
+                return
+        try:
+            jax.block_until_ready(self.cache.k)
+        except Exception:
+            return  # can't prove the writes landed; quarantine stays parked
+        with self._page_lock:
+            self._page_free.extend(self._page_quarantine)
+            self._page_quarantine = []
+
+    def _release_quarantine(self) -> None:
+        """Worker loop, once the readback FIFO is empty: every dispatch
+        that could touch quarantined pages has drained."""
+        if self._page_quarantine and not self._readbacks:
+            with self._page_lock:
+                if self._page_quarantine and not self._readbacks:
+                    self._page_free.extend(self._page_quarantine)
+                    self._page_quarantine = []
+
+    def _decref_page(self, pid: int) -> None:
+        with self._page_lock:
+            self._page_refs[pid] -= 1
+            if self._page_refs[pid] <= 0:
+                self._page_refs[pid] = 0
+                self._free_page_ids([pid])
+
+    def _free_session_pages(self, sess: PagedSession) -> None:
+        pages, sess.pages, sess.shared = sess.pages, [], 0
+        for pid in pages:
+            self._decref_page(pid)
+
+    def _bind_lane_bt(self, slot: Slot, sess: PagedSession) -> None:
+        """Point the lane's block-table row at the session's pages; every
+        uncovered block falls back to the lane's scratch page."""
+        self._bt[slot.idx, :] = self._scratch_page(slot.idx)
+        if sess.pages:
+            self._bt[slot.idx, : len(sess.pages)] = sess.pages
+
+    def _ensure_lane_pages(self, slot: Slot, upto_pos: int, serving: bool) -> None:
+        """Grow the bound session's page list (and the lane's table row) to
+        cover writes through logical position ``upto_pos``. Called before
+        every prefill/decode/verify dispatch so the compiled call never
+        needs in-flight table growth; allocation failure surfaces as
+        PagePoolExhausted for THIS request only."""
+        sess = slot.psess
+        if sess is None:
+            return
+        blocks = min(max(0, upto_pos), self.max_seq - 2) // self.page_size + 1
+        have = len(sess.pages)
+        if have >= blocks:
+            return
+        new = self._alloc_pages(blocks - have, serving=serving)
+        sess.pages.extend(new)
+        self._bt[slot.idx, have:blocks] = new
+
+    def _truncate_session_pages(self, sess: PagedSession) -> None:
+        """Page-tail truncation: free whole pages beyond the live context.
+        This is what speculative rewind and chunk overshoot become in the
+        paged arena — rejected-draft KV beyond ``position`` was already
+        position-masked; here the PAGES holding only such garbage go back
+        to the pool instead of staying pinned to the session."""
+        with self._page_lock:
+            keep = (
+                0 if sess.position <= 0 else (sess.position - 1) // self.page_size + 1
+            )
+            keep = max(keep, sess.shared)  # never drop mapped prefix pages
+            if len(sess.pages) <= keep:
+                return
+            tail = sess.pages[keep:]
+            del sess.pages[keep:]
+            if sess.lane is not None:
+                # un-map the freed blocks from the live lane: a stale table
+                # entry is read-masked but must never be WRITTEN through
+                self._bt[sess.lane, keep:] = self._scratch_page(sess.lane)
+            self.pages_truncated += len(tail)
+            for pid in tail:
+                self._decref_page(pid)
+
+    def _rollback_lane_session(self, slot: Slot) -> None:
+        """Paged lane reset for a POLICY failure (pool exhaustion → 429):
+        unlike a fault, no dispatch died mid-write — the session's KV below
+        its admission-time position is intact, and only this request's
+        prefill/partial generation (which the recorded history will never
+        contain) must go. Truncate back, restore the admission-time pending
+        token, and keep the session RESIDENT: the client's Retry-After
+        retry continues the conversation instead of finding it destroyed."""
+        sess = slot.psess
+        if sess is None or not sess.name or sess.admit_position <= 0:
+            # fresh or anonymous context: nothing pre-request to preserve
+            # (a fresh prefix-hit admission advanced position, but those
+            # mapped tokens belong to the failed request — drop them too)
+            self._drop_lane_session(slot)
+            return
+        with self._page_lock:
+            slot.psess = None
+            self._bt[slot.idx, :] = self._scratch_page(slot.idx)
+            # roll position back too: the prefix map and speculative accept
+            # syncs both advance it mid-request, and every such token
+            # belongs to the request that just failed with 429
+            sess.position = sess.admit_position
+            sess.pending_token = sess.admit_pending
+            # spec_hist was extended in place at admission (and by every
+            # accepted token since); restore the saved copy so a retry of
+            # the same prompt doesn't duplicate its region in the drafting
+            # corpus and tank the lookup accept rate
+            sess.spec_hist = list(sess.admit_spec_hist)
+            sess.last_used = time.monotonic()
+            sess.lane = None
+            self.sessions[sess.name] = -1
+            self._truncate_session_pages(sess)
+        slot.session = ""
+        # the session is provably idle right now: stage any snapshot that
+        # parked while the failed request was in flight (mirrors the finish
+        # path's _service_parked_snapshot — without this the parked cmd's
+        # future never resolves and the serve layer awaits it forever)
+        cmd = self._snap_parked.pop(sess.name, None)
+        if cmd is not None:
+            self._stage_snapshot_paged(cmd, sess)
+
+    def _drop_lane_session(self, slot: Slot) -> None:
+        """Paged half of a lane reset after a FAULT/abort: the bound
+        session's KV is no longer trusted (the failed call may have died
+        mid-write), so its pages go back to the pool and the session
+        leaves residency entirely (the store snapshot still allows resume)."""
+        with self._page_lock:
+            sess, slot.psess = slot.psess, None
+            self._bt[slot.idx, :] = self._scratch_page(slot.idx)
+            if sess is None:
+                return
+            self._free_session_pages(sess)
+            if sess.name:
+                self.paged_sessions.pop(sess.name, None)
+                self.sessions.pop(sess.name, None)
+                self._flush_parked_snapshot(sess.name)
+            slot.session = ""
+
+    def _detach_lane(self, slot: Slot) -> None:
+        """A finished request releases its COMPUTE lane while the session
+        stays resident in pages — the decoupling that lets resident
+        sessions outnumber max_batch. Lane spec/position state syncs back
+        to the session; anonymous (sessionless) generations free their
+        pages immediately."""
+        with self._page_lock:
+            sess, slot.psess = slot.psess, None
+            self._bt[slot.idx, :] = self._scratch_page(slot.idx)
+            if sess is None:
+                return
+            sess.spec_ema = slot.spec_ema
+            sess.spec_miss = slot.spec_miss
+            sess.last_used = time.monotonic()
+            sess.lane = None
+            if sess.name:
+                self.sessions[sess.name] = -1
+                self._truncate_session_pages(sess)
+            else:
+                self._free_session_pages(sess)
+        slot.session = ""
+        slot.position = 0
+        slot.pending_token = None
+        slot.spec_hist = []
+
+    # paged compiled helpers: exact-page-count gather/scatter programs.
+    # Counts are bounded by the block-table width (≤ max_seq/page_size
+    # distinct shapes, each a trivial gather), warmed at pow2 counts.
+
+    def _snap_fn_paged(self, count: int):
+        fn = self._snap_paged_fns.get(count)
+        if fn is None:
+
+            def _snap(cache, ids, _c=count):
+                # EXACT dtype (see _snap_fn): gather ONLY the session's
+                # live pages and lay them out contiguously — the blob
+                # layout matches the dense staging, so snapshots restore
+                # across paged and dense engines alike
+                k = cache.k[:, ids]
+                v = cache.v[:, ids]
+                l = cache.k.shape[0]
+                return (
+                    k.reshape(l, _c * self.page_size, *cache.k.shape[3:]),
+                    v.reshape(l, _c * self.page_size, *cache.v.shape[3:]),
+                )
+
+            fn = self._snap_paged_fns[count] = jax.jit(_snap)
+        return fn
+
+    def _restore_fn_paged(self, count: int):
+        fn = self._restore_paged_fns.get(count)
+        if fn is None:
+
+            def _restore(cache, ids, k, v):
+                # k/v arrive [L, count, page_size, KV, hd]; scatter into
+                # the session's freshly-allocated pages
+                return type(cache)(
+                    cache.k.at[:, ids].set(k), cache.v.at[:, ids].set(v)
+                )
+
+            fn = self._restore_paged_fns[count] = jax.jit(
+                _restore, donate_argnums=(0,)
+            )
+        return fn
+
+    def _page_copy_fn(self):
+        """One-page pool copy (src → dst): the partial-tail copy-on-write
+        for non-page-aligned prefix levels. Full pages are never copied —
+        that is the zero-copy claim."""
+        fn = self._page_copy_fn_cached
+        if fn is None:
+
+            def _copy(cache, src, dst):
+                k = lax.dynamic_slice_in_dim(cache.k, src, 1, axis=1)
+                v = lax.dynamic_slice_in_dim(cache.v, src, 1, axis=1)
+                return type(cache)(
+                    lax.dynamic_update_slice_in_dim(cache.k, k, dst, axis=1),
+                    lax.dynamic_update_slice_in_dim(cache.v, v, dst, axis=1),
+                )
+
+            fn = self._page_copy_fn_cached = jax.jit(_copy, donate_argnums=(0,))
         return fn
 
     # -- prefix arena (cross-session KV reuse; worker thread) -------------
@@ -1437,6 +2068,10 @@ class LLMEngine:
                 key = (b, hashes[b])
                 if key in self._prefix_entries:
                     continue
+                if self.paged:
+                    if not self._prefix_register_paged(slot, ctx, b, key, now):
+                        break
+                    continue
                 k, v = self._prefix_slice_fn(b)(self.cache, jnp.int32(slot.idx))
                 nbytes = int(k.nbytes + v.nbytes)
                 if nbytes > self._prefix_budget:
@@ -1458,9 +2093,99 @@ class LLMEngine:
         except Exception as e:
             self._note_error(e)
 
+    def _prefix_register_paged(
+        self, slot: Slot, ctx: list[int], b: int, key: tuple, now: float
+    ) -> bool:
+        """Zero-copy paged registration: pin the owning session's full
+        pages below ``b`` by refcount — no device copy at all for
+        page-aligned levels. A non-aligned level (bucket 32 under the
+        64-token default page) eagerly copies its partial tail page once,
+        because the owner keeps writing the rest of that page. Returns
+        False to stop the level walk (budget exhausted)."""
+        sess = slot.psess
+        if sess is None:
+            return False
+        full = b // self.page_size
+        tail_len = b % self.page_size
+        page_bytes = self._page_nbytes()
+        nbytes = (full + (1 if tail_len else 0)) * page_bytes
+        if nbytes > self._prefix_budget:
+            return False
+        if len(sess.pages) < full + (1 if tail_len else 0):
+            return False  # context shorter than the level (can't happen)
+        # budget charge is the DISTINCT pinned page count: levels of one
+        # context share their full pages, so summing per-entry spans (the
+        # dense formula, where every level is a real private copy) would
+        # double-count and stop registration far short of the budget
+        full_pages = sess.pages[:full]
+
+        def projected() -> int:
+            pinned = self._prefix_pinned_page_ids()
+            extra = sum(1 for p in full_pages if p not in pinned)
+            return (len(pinned) + extra + (1 if tail_len else 0)) * page_bytes
+
+        while projected() > self._prefix_budget and self._prefix_entries:
+            self._prefix_evict_lru(now)
+        tail_page = None
+        if tail_len:
+            # best-effort, no reclaim: pinning a prefix must never evict a
+            # live resident session, and a full pool just stops the level
+            # walk — registration is an optimization, not backpressure
+            try:
+                tail_page = self._alloc_pages(1, serving=False, reclaim=False)[0]
+            except EngineOverloaded:
+                return False
+            self.cache = self._page_copy_fn()(
+                self.cache, jnp.int32(sess.pages[full]), jnp.int32(tail_page)
+            )
+        pages = list(sess.pages[:full])
+        with self._page_lock:
+            for pid in pages:
+                self._page_refs[pid] += 1
+        self._prefix_entries[key] = PrefixEntry(
+            k=None,
+            v=None,
+            tokens=tuple(ctx[:b]),
+            nbytes=nbytes,
+            created=now,
+            last_used=now,
+            pages=pages,
+            tail_page=tail_page,
+            tail_len=tail_len,
+        )
+        self._recount_prefix_pinned()
+        return True
+
+    def _page_nbytes(self) -> int:
+        return int((self.cache.k.nbytes + self.cache.v.nbytes) / self._total_pages)
+
+    def _prefix_pinned_page_ids(self) -> set[int]:
+        """Distinct physical pages pinned by the paged prefix arena —
+        levels of one context share pages, so per-entry spans overlap."""
+        pinned: set[int] = set()
+        for e in self._prefix_entries.values():
+            if e.pages is not None:
+                pinned.update(e.pages)
+                if e.tail_page is not None:
+                    pinned.add(e.tail_page)
+        return pinned
+
+    def _recount_prefix_pinned(self) -> None:
+        self._prefix_bytes = len(self._prefix_pinned_page_ids()) * self._page_nbytes()
+
     def _prefix_evict_lru(self, now: float | None = None) -> None:
         key, entry = self._prefix_entries.popitem(last=False)
         self._prefix_bytes -= entry.nbytes
+        if entry.pages is not None:
+            # unpin: sessions still mapping these pages keep their own
+            # references — only the arena's pin drops
+            for pid in entry.pages:
+                self._decref_page(pid)
+            if entry.tail_page is not None:
+                self._decref_page(entry.tail_page)
+            # distinct-page accounting: surviving entries may still pin
+            # pages this entry shared, so recount instead of subtracting
+            self._recount_prefix_pinned()
         self._count_eviction(
             "prefix", (now or time.monotonic()) - entry.last_used
         )
@@ -1497,6 +2222,17 @@ class LLMEngine:
         """Drop idle sessions (all, or only those whose name starts with
         ``prefix`` — a multi-tenant host clears one tenant's namespace
         without touching its co-tenants' KV)."""
+        if self.paged:
+            with self._page_lock:
+                for name in [s for s in self.paged_sessions if s.startswith(prefix)]:
+                    sess = self.paged_sessions[name]
+                    if sess.lane is not None:
+                        continue  # request in flight; same skip as dense
+                    self._flush_parked_snapshot(name)
+                    self._free_session_pages(sess)
+                    self.paged_sessions.pop(name, None)
+                    self.sessions.pop(name, None)
+            return
         with self._lock:
             for name in [s for s in self.sessions if s.startswith(prefix)]:
                 idx = self.sessions.pop(name)
@@ -1603,6 +2339,11 @@ class LLMEngine:
                 if (pev := sorted(self.prefix_eviction_idle_s_recent))
                 else None
             ),
+            # paged KV arena (block tables): pool occupancy gauges replace
+            # the dense-only slot accounting as the HBM audit — resident
+            # sessions are bounded by pages, not max_batch, so capacity
+            # questions are answered here, not by active_sessions alone
+            **self._paged_metrics(),
             # raw append-ordered samples (bounded deques): lets a caller
             # window percentiles over ITS measurement interval instead of
             # whatever warmup/compile history the deque still holds
@@ -1639,6 +2380,43 @@ class LLMEngine:
             "hbm_bytes_per_chip_est": int(
                 (self.param_hbm_bytes + self.kv_arena_bytes) / self._n_chips
             ),
+        }
+
+    def _paged_metrics(self) -> dict:
+        if not self.paged:
+            return {"paged_kv": False}
+        with self._page_lock:
+            free = len(self._page_free)
+            quarantined = len(self._page_quarantine)
+            per_sess = sorted(
+                len(s.pages) for s in self.paged_sessions.values()
+            )
+            live_tokens = sum(s.position for s in self.paged_sessions.values())
+            pinned = len(self._prefix_pinned_page_ids())
+        allocated = sum(per_sess)
+        # internal fragmentation: allocated page capacity the resident
+        # sessions' live tokens don't fill (the cost of page granularity —
+        # dense slots score (1 - position/max_seq) on the same formula)
+        frag = (
+            round(100.0 * (1.0 - live_tokens / (allocated * self.page_size)), 2)
+            if allocated
+            else 0.0
+        )
+        return {
+            "paged_kv": True,
+            "page_size": self.page_size,
+            "kv_pages_total": self._data_pages,
+            "kv_pages_free": free,
+            "kv_pages_used": self._data_pages - free - quarantined,
+            "kv_pages_quarantined": quarantined,
+            "kv_pages_prefix_pinned": pinned,
+            "resident_sessions": len(self.paged_sessions),
+            "session_pages_p50": per_sess[len(per_sess) // 2] if per_sess else None,
+            "session_pages_max": per_sess[-1] if per_sess else None,
+            "kv_fragmentation_pct": frag,
+            "page_exhausted_total": self.page_exhausted_total,
+            "pages_truncated_total": self.pages_truncated,
+            "prefix_pages_shared_total": self.prefix_pages_shared,
         }
 
     def begin_drain(self) -> None:
@@ -1700,6 +2478,10 @@ class LLMEngine:
             self._pump_queue(0.0 if (busy or self._waiting) else 0.2)
             if self._sentinel:
                 break
+            if self.paged:
+                # freed pages parked behind in-flight dispatches become
+                # allocatable once the readback FIFO has drained
+                self._release_quarantine()
             self._admit_waiting()
             # cancelled/expired in-flight lanes are reaped BEFORE dispatching
             # more device work for them; their freed slots are admissible on
@@ -1808,6 +2590,12 @@ class LLMEngine:
                     pass  # expired/cancelled before prefill — already failed
                 elif not self._try_admit(item):
                     still.append(item)
+            except EngineOverloaded as e:
+                # pool backpressure at admission (the prefix tail-CoW
+                # alloc): a policy 429, not a worker fault — fail typed
+                # without polluting the worker-error channel, matching the
+                # prefill/decode exhaustion handlers
+                self._fail_item(item, e)
             except Exception as e:
                 # a poisoned request/snapshot must not kill the worker
                 self._note_error(e)
@@ -1882,7 +2670,7 @@ class LLMEngine:
             self._fail_item(req, err)
             self._abandon_slot(slot)
 
-    def _abandon_slot(self, slot: Slot) -> None:
+    def _abandon_slot(self, slot: Slot, rollback: bool = False) -> None:
         """Free a slot whose request was aborted mid-flight: park its decode
         lane (chunks already dispatched keep stepping it until the park
         injection lands, their tokens skipped at processing), then return
@@ -1901,7 +2689,7 @@ class LLMEngine:
                 jnp.int32(self.scratch_pos),
                 jnp.float32(0.0),
             )
-        self._reset_slot(slot)
+        self._reset_slot(slot, rollback=rollback)
 
     def _has_dispatchable(self) -> bool:
         """Is there device work left to dispatch? Pending prompt chunks, or
@@ -1941,9 +2729,17 @@ class LLMEngine:
         self.last_worker_error = f"{type(e).__name__}: {e}"
         print(f"[llm-engine] worker error: {self.last_worker_error}", flush=True)
 
-    def _reset_slot(self, slot: Slot) -> None:
+    def _reset_slot(self, slot: Slot, rollback: bool = False) -> None:
         """Return a slot to cold idle after its request failed: KV prefix is
-        no longer trusted (the fault may have landed mid-write)."""
+        no longer trusted (the fault may have landed mid-write). With
+        ``rollback`` (policy failures: pool exhaustion — the alloc fails
+        BEFORE any dispatch) the paged session's pre-request KV is trusted
+        and preserved instead."""
+        if self.paged:
+            if rollback:
+                self._rollback_lane_session(slot)
+            else:
+                self._drop_lane_session(slot)
         slot.request = None
         slot.pending_prompt = []
         slot.decoding = False
@@ -1983,6 +2779,18 @@ class LLMEngine:
                     self._fail_item(slot.request, RuntimeError("KV arena reset"))
                 self._reset_slot(slot)
             self.sessions.clear()
+            if self.paged:
+                # the pool's contents are gone: every session, prefix pin,
+                # and quarantined id referenced the lost arrays
+                with self._page_lock:
+                    self.paged_sessions.clear()
+                    self._prefix_entries.clear()
+                    self._prefix_bytes = 0
+                    self._page_free = list(range(self._data_pages - 1, -1, -1))
+                    self._page_refs[:] = 0
+                    self._page_quarantine = []
+                    for i in range(self.max_batch):
+                        self._bt[i, :] = self._scratch_page(i)
         carry_lost = False
         for arr in (self._dtok, self._dpos, self._dtemps):
             try:
@@ -2005,16 +2813,74 @@ class LLMEngine:
 
         ok = False
         try:
+            if self.paged:
+                ok = self._do_restore_paged(cmd)
+                return
             slot = self._find_slot(cmd.session)
             if slot is not None and cmd.position < self.max_seq - 1:
                 self.cache = restore_kv_slot(self.cache, slot.idx, cmd.k, cmd.v)
                 slot.position = cmd.position
                 slot.pending_token = cmd.pending_token
+                # a restored slot is LIVE now: without this, its last_used
+                # is whatever its previous occupant left (often 0), so the
+                # very next admission/restore picks it as the LRU victim
+                # and silently evicts the session that was just restored
+                # (the paged restore path already stamps last_used)
+                slot.last_used = time.monotonic()
                 ok = True
         finally:
             # resolve even on exception (shape-mismatched snapshots from a
             # redeployed model config must not hang the caller)
             cmd.loop.call_soon_threadsafe(_resolve_value, cmd.future, ok)
+
+    def _do_restore_paged(self, cmd: RestoreCmd) -> bool:
+        """Restore into PAGES, not a lane: the session enters residency
+        without occupying a compute lane at all (a restored session that
+        never speaks again costs only its pages). Exhaustion surfaces as
+        False — the caller re-prefills instead."""
+        if not cmd.session or cmd.position >= self.max_seq - 1 or cmd.position <= 0:
+            return False
+        # under _page_lock against API-thread clear_sessions: the
+        # existing-session teardown and the new binding must be atomic
+        with self._page_lock:
+            existing = self.paged_sessions.get(cmd.session)
+            if existing is not None:
+                if existing.lane is not None:
+                    return False  # mid-generation: never clobber live KV
+                self._free_session_pages(existing)
+                self.paged_sessions.pop(cmd.session, None)
+                self.sessions.pop(cmd.session, None)
+        count = (cmd.position - 1) // self.page_size + 1
+        try:
+            ids = self._alloc_pages(count, serving=False)
+        except EngineOverloaded:
+            return False
+        k = np.asarray(cmd.k)
+        v = np.asarray(cmd.v)
+        pad = count * self.page_size - k.shape[1]
+        if pad:
+            widths = [(0, 0), (0, pad)] + [(0, 0)] * (k.ndim - 2)
+            k = np.pad(k, widths)
+            v = np.pad(v, widths)
+        dtype = self.cache.k.dtype
+        shape = (k.shape[0], count, self.page_size, *k.shape[2:])
+        self.cache = self._restore_fn_paged(count)(
+            self.cache,
+            jnp.asarray(np.asarray(ids, dtype=np.int32)),
+            jnp.asarray(k.reshape(shape), dtype),
+            jnp.asarray(v.reshape(shape), dtype),
+        )
+        sess = PagedSession(
+            name=cmd.session,
+            pages=ids,
+            position=cmd.position,
+            pending_token=cmd.pending_token,
+            last_used=time.monotonic(),
+        )
+        with self._page_lock:
+            self.paged_sessions[cmd.session] = sess
+            self.sessions[cmd.session] = -1
+        return True
 
     def _fail_item(self, item, error: Exception) -> None:
         fut = getattr(item, "future", None)
@@ -2025,21 +2891,42 @@ class LLMEngine:
             except RuntimeError:
                 pass  # caller's loop already closed; nobody left to notify
 
+    def _admit_prologue(
+        self, position: int, pending_token: int | None, req: GenRequest
+    ) -> tuple[list[int], int | None, bool]:
+        """Shared admission prologue — ONE implementation for both arenas,
+        because greedy A/B parity between them hinges on these semantics
+        matching exactly. Splices the held-out pending token into the
+        prompt, decides whether the continuation fits the budget (reset
+        otherwise — and the pending token belongs to the context being
+        DISCARDED: keeping it would prefill one stale token that an engine
+        without a held-out pending never sees, breaking parity at exactly
+        the reset boundary), and trims an over-long prompt to its tail.
+        Returns (prompt, original_pending, reset)."""
+        prompt = list(req.prompt_ids)
+        pend = pending_token
+        if pend is not None:
+            prompt = [pend] + prompt
+        budget = self.max_seq - 1 - req.max_tokens
+        reset = position + len(prompt) > budget
+        if reset and pend is not None:
+            prompt = prompt[1:]
+        if len(prompt) > budget:
+            prompt = prompt[-budget:]  # keep the tail
+        return prompt, pend, reset
+
     def _try_admit(self, req: GenRequest) -> bool:
+        if self.paged:
+            return self._try_admit_paged(req)
         slot = self._find_slot(req.session)
         if slot is None:
             return False
-        prompt = list(req.prompt_ids)
-        if slot.pending_token is not None:
-            prompt = [slot.pending_token] + prompt
-            slot.pending_token = None
-        # continuation prompt must fit: otherwise reset the session's KV
-        budget = self.max_seq - 1 - req.max_tokens
-        if slot.position + len(prompt) > budget:
+        prompt, _, reset = self._admit_prologue(slot.position, slot.pending_token, req)
+        slot.pending_token = None
+        if reset:
+            # continuation didn't fit: reset the session's KV
             slot.position = 0
             slot.epoch += 1
-        if len(prompt) > budget:
-            prompt = prompt[-budget:]  # keep the tail
         # Fresh context (position 0): fork the longest cached prefix into
         # this slot instead of re-prefilling it — a second session with a
         # shared system prompt skips ~all of its prefill. Continuing
@@ -2093,6 +2980,161 @@ class LLMEngine:
         slot.pending_prompt = prompt[forked:]
         slot.last_used = time.monotonic()
         return True
+
+    def _try_admit_paged(self, req: GenRequest) -> bool:
+        """Paged admission: bind the session (resident or new) to ANY free
+        compute lane — lanes carry no KV affinity, the pages do — and map
+        the longest cached prefix as refcounted pages instead of forking a
+        copy. Mirrors the dense _try_admit flow step for step so greedy
+        scheduling (and therefore token streams) stay identical. Runs
+        under _page_lock: an API-thread clear_sessions checks ``lane is
+        None`` and frees pages, so it must never interleave with a bind —
+        a session cleared between the lookup and ``sess.lane = idx`` would
+        have its just-mapped pages returned to the pool and handed to
+        another session while this lane writes through them."""
+        # Pre-drain the quarantine OUTSIDE the lock when the pool looks
+        # short for this request: _alloc_pages' quarantine reap waits on
+        # in-flight device work (jax.block_until_ready), and paying that
+        # wait while holding _page_lock would stall every API-thread lock
+        # consumer (stats/clear_sessions) for the duration. Out here only
+        # the worker waits; inside, the reap then finds the quarantine
+        # already empty. (Worst-case page need for this admission; a race
+        # refilling the quarantine in between just falls back to the
+        # locked wait, which is correct, merely slower.)
+        need = (len(req.prompt_ids) + req.max_tokens) // self.page_size + 2
+        self._reap_quarantine_if_short(min(need, self._n_blocks))
+        with self._page_lock:
+            return self._try_admit_paged_locked(req)
+
+    def _try_admit_paged_locked(self, req: GenRequest) -> bool:
+        name = req.session
+        sess = self.paged_sessions.get(name) if name else None
+        if sess is not None and sess.lane is not None:
+            return False  # session busy: one request per session at a time
+        lane = next((s for s in self.slots if s.request is None), None)
+        if lane is None:
+            return False
+        fresh_session = sess is None
+        if fresh_session:
+            sess = PagedSession(name=name)
+        prompt, pend, reset = self._admit_prologue(sess.position, sess.pending_token, req)
+        sess.pending_token = None
+        if reset:
+            # continuation didn't fit: reset the session's KV (pages too)
+            self._free_session_pages(sess)
+            sess.position = 0
+        # pre-request state for pool-exhaustion rollback: the pending token
+        # was just consumed into the prompt and must return with a rollback,
+        # the position is about to advance (prefix map below; spec accepts
+        # mid-request), and spec_hist is about to be extended in place.
+        # After a context reset there is no pre-request state worth keeping
+        # (admit_position 0 → drop).
+        sess.admit_pending = pend if sess.position > 0 else None
+        sess.admit_position = sess.position
+        sess.admit_spec_hist = list(sess.spec_hist) if sess.position > 0 else []
+        forked = 0
+        fresh = sess.position == 0
+        if fresh:
+            sess.spec_hist = list(prompt)
+        else:
+            sess.spec_hist.extend(prompt)
+            del sess.spec_hist[: -self.max_seq]
+        try:
+            if self._prefix_active and fresh:
+                if self._prefix_levels and len(prompt) > self._prefix_levels[0]:
+                    hit = self._prefix_lookup(prompt)
+                    if hit is not None and hit[1].pages is not None:
+                        key, entry = hit
+                        forked = self._map_prefix_pages(sess, key, entry)
+                    else:
+                        self.prefix_misses += 1
+                lane.prefix_ctx = list(prompt)
+            else:
+                lane.prefix_ctx = None
+        except Exception:
+            # partial mappings must not leak a half-built session into
+            # residency: free what was mapped, then surface the error
+            # (_admit_waiting fails the request — 429 for pool exhaustion)
+            self._free_session_pages(sess)
+            if not fresh_session and name:
+                self.paged_sessions.pop(name, None)
+                self.sessions.pop(name, None)
+            raise
+        # bind: the lane mirrors the session while the request is in flight
+        if fresh_session and name:
+            self.paged_sessions[name] = sess
+        sess.lane = lane.idx
+        sess.last_used = time.monotonic()
+        if name:
+            self.sessions[name] = lane.idx
+        lane.psess = sess
+        lane.session = name
+        lane.position = sess.position
+        lane.pending_token = None
+        lane.spec_hist = sess.spec_hist
+        lane.spec_ema = sess.spec_ema
+        lane.spec_miss = sess.spec_miss
+        lane.epoch += 1
+        lane.request = req
+        lane.pending_prompt = prompt[forked:]
+        lane.last_used = time.monotonic()
+        self._bind_lane_bt(lane, sess)
+        return True
+
+    def _map_prefix_pages(self, sess: PagedSession, key: tuple, entry) -> int:
+        """Zero-copy prefix fork: the session's block table maps the
+        entry's full pages read-only (one refcount bump per page, no
+        device traffic); only a partial tail page is copied — and only
+        when the level isn't page-aligned. Returns the forked token count."""
+        b = key[0]
+        # take this session's page references FIRST: the tail-copy
+        # allocation below may reclaim, and reclaim may evict THIS entry
+        # (it is not re-LRU'd until the hit is recorded) — with the refs
+        # already held, an eviction only drops the arena's pin while the
+        # pages (and the tail-copy source) stay live for the mapping
+        pages = list(entry.pages)
+        tail_src = entry.tail_page
+        with self._page_lock:
+            for pid in pages:
+                self._page_refs[pid] += 1
+            if tail_src is not None:
+                self._page_refs[tail_src] += 1
+        tail_copy = None
+        try:
+            if tail_src is not None:
+                # copy-on-write at the partial last page: this session will
+                # write positions [b, page boundary) into that same page
+                tail_copy = self._alloc_pages(1, serving=True)[0]
+                self.cache = self._page_copy_fn()(
+                    self.cache, jnp.int32(tail_src), jnp.int32(tail_copy)
+                )
+        except BaseException:
+            with self._page_lock:
+                for pid in pages:
+                    self._decref_page(pid)
+                if tail_copy is not None:
+                    self._decref_page(tail_copy)
+            raise
+        finally:
+            if tail_src is not None:
+                self._decref_page(tail_src)
+        sess.pages = pages
+        sess.shared = len(pages)
+        if tail_copy is not None:
+            sess.pages.append(tail_copy)
+        sess.position = b
+        entry.hits += 1
+        entry.last_used = time.monotonic()
+        if key in self._prefix_entries:  # the alloc may have evicted it
+            self._prefix_entries.move_to_end(key)
+        self.prefix_hits += 1
+        self.prefix_tokens_saved += b
+        self.prefix_pages_shared += len(pages)
+        # HBM traffic: ONLY the tail copy streams bytes — the whole point
+        # of page mapping vs the dense fork's full-prefix copy
+        if tail_copy is not None:
+            self.hbm_bytes_read += self.page_size * self._kv_bytes_per_pos
+        return b
 
     def _find_slot(self, session: str) -> Slot | None:
         if session and session in self.sessions:
@@ -2171,9 +3213,35 @@ class LLMEngine:
         positions = np.arange(slot.position, slot.position + bucket, dtype=np.int32)
         tokens = jnp.asarray(np.array(padded, dtype=np.int32)[None])
         pos = jnp.asarray(positions[None])
-        last_logits, self.cache = self._prefill(
-            self.params, self.cache, jnp.int32(slot.idx), tokens, pos, jnp.int32(n)
-        )
+        if self.paged:
+            # pages cover the REAL tokens only; bucket-padding writes past
+            # them fall into the lane's scratch page via the table default
+            # (and clamp in-kernel past the logical arena) — exactly as
+            # invisible as the dense path's dropped out-of-range scatter
+            try:
+                self._ensure_lane_pages(
+                    slot, slot.position + n - 1, serving=bool(req.id)
+                )
+            except EngineOverloaded as e:
+                # policy backpressure, not a fault: fail THIS request with
+                # the typed 429 and roll the session back — the worker
+                # loop's generic prefill handler would count a worker
+                # error and destroy the resident session
+                self._fail_item(req, e)
+                self._abandon_slot(slot, rollback=True)
+                return
+            last_logits, self.cache = self._prefill(
+                self.params,
+                self.cache,
+                jnp.asarray(self._bt[slot.idx : slot.idx + 1]),
+                tokens,
+                pos,
+                jnp.int32(n),
+            )
+        else:
+            last_logits, self.cache = self._prefill(
+                self.params, self.cache, jnp.int32(slot.idx), tokens, pos, jnp.int32(n)
+            )
         # n real tokens, each attending ~its own position of context
         self.flops_done += n * self.cfg.flops_per_token(slot.position + n // 2)
         self.hbm_bytes_read += self.param_hbm_bytes + (
@@ -2263,13 +3331,27 @@ class LLMEngine:
             # first-readback (sums to ttft_ms up to rounding)
             "ttft_breakdown": breakdown,
         }
+        # Paged: settle the SESSION before resolving the caller — sync the
+        # lane's final state back, stage any parked snapshot (the staging
+        # reads the synced session), then release the compute lane (the
+        # session stays resident in pages, holding zero lanes between
+        # turns; overshoot page tails go back to the pool). Resolving last
+        # means "await chat() returned" implies the session is settled —
+        # callers and tests can inspect residency without racing the worker.
+        if self.paged:
+            if slot.psess is not None:
+                slot.psess.position = slot.position
+                slot.psess.pending_token = slot.pending_token
+            self._service_parked_snapshot(slot)
+            self._detach_lane(slot)
         req.loop.call_soon_threadsafe(_resolve, req.future, result)
         # a cancel that raced a natural finish loses: drop its stale marker
         with self._lock:
             self._cancel_requested.pop(req.id, None)
-        # settle point: the slot is idle RIGHT NOW — stage any snapshot that
-        # parked while this request was generating
-        self._service_parked_snapshot(slot)
+        if not self.paged:
+            # settle point: the slot is idle RIGHT NOW — stage any snapshot
+            # that parked while this request was generating
+            self._service_parked_snapshot(slot)
 
     def _decode_dispatch(self) -> None:
         """Dispatch one decode chunk chained on the device carry and queue
@@ -2295,10 +3377,34 @@ class LLMEngine:
         if any(r.id for _, r, _ in snapshot):
             faults.fire("engine.decode_step")
         chunk = self._pick_chunk(needed)
+        if self.paged:
+            # pre-allocate pages covering every step of the chunk so the
+            # block table is constant across the compiled scan; a lane the
+            # pool can't cover fails with 429 backpressure — the others
+            # keep decoding
+            kept = []
+            for s, r, p in snapshot:
+                try:
+                    self._ensure_lane_pages(
+                        s, min(p + chunk - 1, self.max_seq - 2), serving=bool(r.id)
+                    )
+                    kept.append((s, r, p))
+                except EngineOverloaded as e:
+                    self._fail_item(r, e)
+                    self._abandon_slot(s, rollback=True)
+            snapshot = kept
+            if not snapshot:
+                return
         self._rng, key = jax.random.split(self._rng)
         keys = jax.random.split(key, chunk)
         toks, self._dtok, self._dpos, self.cache = self._decode_n(
-            self.params, self.cache, self._dtok, self._dpos, self._dtemps, keys
+            self.params,
+            self.cache,
+            *self._bt_arg(),
+            self._dtok,
+            self._dpos,
+            self._dtemps,
+            keys,
         )
         for s, r, _ in snapshot:
             s.dev_position += chunk
@@ -2374,14 +3480,16 @@ class LLMEngine:
         if fn is None:
             run_forward = self._run_forward
 
-            def verify(params, cache, tok, pos, temps, drafts, dlen, key):
-                scratch = cache.k.shape[2] - 1
+            def verify_body(params, cache, tok, pos, temps, drafts, dlen, key, bt=None):
+                # the paged pool's page axis says nothing about the logical
+                # arena length — scratch comes from the engine statics there
+                scratch = cache.k.shape[2] - 1 if bt is None else self.max_seq - 1
                 toks = jnp.concatenate([tok[:, None], drafts], axis=1)  # [B,K+1]
                 offs = jnp.arange(K + 1, dtype=jnp.int32)[None, :]
                 # parked lanes (and padding rows past a lane's draft_len)
                 # clamp at the scratch position, exactly like plain decode
                 positions = jnp.minimum(pos[:, None] + offs, scratch)
-                logits, cache = run_forward(params, toks, positions, cache)
+                logits, cache = run_forward(params, toks, positions, cache, bt)
                 greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 k_acc, k_bonus = jax.random.split(key)
                 # draft_j (= toks[:, j+1]) is scored by logits row j. Greedy
@@ -2427,7 +3535,22 @@ class LLMEngine:
                 new_pos = jnp.minimum(pos + count, scratch)
                 return emitted, count, bonus, new_pos, cache
 
-            fn = self._verify_fns[K] = jax.jit(verify, donate_argnums=(1, 2, 3))
+            if self.paged:
+
+                def verify_paged(params, cache, bt, tok, pos, temps, drafts, dlen, key):
+                    return verify_body(
+                        params, cache, tok, pos, temps, drafts, dlen, key, bt
+                    )
+
+                fn = self._verify_fns[K] = jax.jit(
+                    verify_paged, donate_argnums=(1, 3, 4)
+                )
+            else:
+
+                def verify(params, cache, tok, pos, temps, drafts, dlen, key):
+                    return verify_body(params, cache, tok, pos, temps, drafts, dlen, key)
+
+                fn = self._verify_fns[K] = jax.jit(verify, donate_argnums=(1, 2, 3))
         return fn
 
     def _spec_gamma(self, slot: Slot) -> int:
@@ -2540,6 +3663,22 @@ class LLMEngine:
         this round ride along as a plain decode step (draft_len 0)."""
         gmax = max(len(d) for _, _, _, d in plan)
         K = next(b for b in self._spec_buckets if b >= gmax)
+        if self.paged:
+            # pages must cover the whole verify write span [p, p+K]; a lane
+            # the pool can't cover fails with backpressure, the rest verify
+            kept = []
+            for s, r, p, d in plan:
+                try:
+                    self._ensure_lane_pages(
+                        s, min(p + K, self.max_seq - 2), serving=bool(r.id)
+                    )
+                    kept.append((s, r, p, d))
+                except EngineOverloaded as e:
+                    self._fail_item(r, e)
+                    self._abandon_slot(s, rollback=True)
+            plan = kept
+            if not plan:
+                return
         drafts = np.zeros((self.max_batch, K), dtype=np.int32)
         dlen = np.zeros((self.max_batch,), dtype=np.int32)
         for s, _, _, d in plan:
@@ -2547,17 +3686,18 @@ class LLMEngine:
                 drafts[s.idx, : len(d)] = d
                 dlen[s.idx] = len(d)
         self._rng, key = jax.random.split(self._rng)
-        emitted_dev, count_dev, self._dtok, self._dpos, self.cache = self._verify_fn(
-            K
-        )(
-            self.params,
-            self.cache,
-            self._dtok,
-            self._dpos,
-            self._dtemps,
-            jnp.asarray(drafts),
-            jnp.asarray(dlen),
-            key,
+        emitted_dev, count_dev, self._dtok, self._dpos, self.cache = (
+            self._verify_fn(K)(
+                self.params,
+                self.cache,
+                *self._bt_arg(),
+                self._dtok,
+                self._dpos,
+                self._dtemps,
+                jnp.asarray(drafts),
+                jnp.asarray(dlen),
+                key,
+            )
         )
         emitted = np.asarray(emitted_dev)  # sync readback: spec rounds don't pipeline
         count = np.asarray(count_dev)
@@ -2618,6 +3758,11 @@ class LLMEngine:
                 slot.position = p + c
                 slot.dev_position = slot.position
                 slot.last_used = end
+                if self.paged and slot.psess is not None:
+                    # rewind = page-tail truncation: pages holding ONLY
+                    # rejected-draft garbage return to the pool right now
+                    slot.psess.position = slot.position
+                    self._truncate_session_pages(slot.psess)
         if self._last_decode_end is not None and total_used:
             self.itl_ms_recent.append(
                 1000 * (end - self._last_decode_end) / total_used
@@ -2796,7 +3941,10 @@ def _resolve_value(future: asyncio.Future, value) -> None:
 
 def _reject(future: asyncio.Future, error: Exception) -> None:
     if not future.done():
-        if isinstance(error, (EngineShutdown, RequestAborted)):
+        # EngineOverloaded covers worker-side PagePoolExhausted: pool
+        # backpressure must reach the serve layer typed (429), not be
+        # laundered into a generic 500
+        if isinstance(error, (EngineShutdown, RequestAborted, EngineOverloaded)):
             future.set_exception(error)  # callers can catch the type
         else:
             future.set_exception(RuntimeError(f"engine worker error: {error}"))

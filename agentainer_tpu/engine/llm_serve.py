@@ -270,6 +270,12 @@ class LLMServeApp:
         env_spec = os.environ.get("ATPU_SPECULATIVE")
         if env_spec is not None and "speculative" not in opts:
             opts["speculative"] = env_spec.lower() in ("1", "true", "yes")
+        # fleet-wide paged-KV-arena default (config features.paged_kv →
+        # daemon exports ATPU_PAGED_KV → engine env); per-deployment model
+        # options still win — same channel as speculative above
+        env_paged = os.environ.get("ATPU_PAGED_KV")
+        if env_paged is not None and "paged_kv" not in opts:
+            opts["paged_kv"] = env_paged.lower() in ("1", "true", "yes")
         if self.chips:
             # no tp injection: LLMEngine.create derives the parallelism
             # split from the chip budget itself (dense → tp-first, MoE →

@@ -166,6 +166,33 @@ class MetricsPlane:
                             round(accepted / drafted, 3) if drafted else None
                         ),
                     }
+                # paged-KV-arena rollup: pool occupancy in one glance —
+                # "how many sessions are resident, how full is the pool,
+                # and is exhaustion backpressure firing" (raw gauges stay
+                # in the engine dict above). This replaces the dense-only
+                # kv_arena_bytes reading as the capacity audit: resident
+                # sessions are bounded by pages, not max_batch.
+                if engine_stats.get("paged_kv"):
+                    total = engine_stats.get("kv_pages_total", 0)
+                    free = engine_stats.get("kv_pages_free", 0)
+                    sample["paged_kv"] = {
+                        "enabled": True,
+                        "pages_total": total,
+                        "pages_free": free,
+                        "pool_utilization": (
+                            round(1.0 - free / total, 3) if total else None
+                        ),
+                        "resident_sessions": engine_stats.get("resident_sessions", 0),
+                        "prefix_pinned_pages": engine_stats.get(
+                            "kv_pages_prefix_pinned", 0
+                        ),
+                        "fragmentation_pct": engine_stats.get(
+                            "kv_fragmentation_pct"
+                        ),
+                        "page_exhausted_total": engine_stats.get(
+                            "page_exhausted_total", 0
+                        ),
+                    }
                 # deadline/overload rollup: one place answering "is this
                 # agent dropping work, and where" — proxy-side sheds (this
                 # sample's proxy.shed) plus the engine's lifetime policy

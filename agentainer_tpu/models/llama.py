@@ -25,7 +25,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.attention import attention_reference, cache_attention, causal_mask, flash_attention
+from ..ops.attention import (
+    attention_reference,
+    cache_attention,
+    causal_mask,
+    flash_attention,
+    paged_cache_attention,
+    scatter_paged_kv,
+)
 from ..ops.norms import rms_norm
 from ..ops.quant import dequant, embed_lookup
 from ..ops.rope import apply_rope
@@ -44,6 +51,31 @@ class KVCache(NamedTuple):
     ) -> "KVCache":
         shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
         return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+class PagedKVCache(NamedTuple):
+    """Block-table KV arena: a global pool of fixed-size pages
+    ``[L, n_pages, page_size, KV, hd]``. A sequence owns a LIST of pages
+    (its block table row) instead of a dense arena row, so resident
+    sessions are bounded by the pool, not the compiled batch width, and
+    shared prefixes are refcounted page mappings instead of copies. Same
+    pytree shape discipline as :class:`KVCache` (two leaves, leading layer
+    axis) so the engine's scan/donation/sharding machinery applies
+    unchanged — under tp the KV-head axis (3) shards exactly like the
+    dense arena's."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+
+    @staticmethod
+    def create(
+        cfg: ModelConfig,
+        n_pages: int,
+        page_size: int,
+        dtype: jnp.dtype = jnp.bfloat16,
+    ) -> "PagedKVCache":
+        shape = (cfg.n_layers, n_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+        return PagedKVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
 def init_params(cfg: ModelConfig, key: jax.Array, dtype: jnp.dtype = jnp.bfloat16) -> dict:
@@ -212,6 +244,7 @@ def _attention_block(
     use_flash: bool,
     attn_impl=None,
     cache_attn_impl=None,
+    block_table=None,
 ):
     b, t, d = x.shape
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
@@ -221,7 +254,15 @@ def _attention_block(
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
 
-    if ck is not None:
+    if ck is not None and block_table is not None:
+        # paged arena: write through the block table into pool pages, then
+        # attend over the gathered page view — same masking rule, same
+        # numbers as the dense scatter+attend below (bit-exact parity)
+        ck, cv = scatter_paged_kv(ck, cv, k, v, block_table, positions)
+        attn = paged_cache_attention(
+            q, ck, cv, block_table, positions, use_pallas=use_flash
+        )
+    elif ck is not None:
         # scatter this step's K/V into the arena at per-sequence positions
         batch_idx = jnp.arange(b)[:, None]
         ck = ck.at[batch_idx, positions].set(k)
@@ -255,11 +296,15 @@ def forward(
     attn_impl=None,
     cache_attn_impl=None,
     moe_impl=None,
+    block_table: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, KVCache | None]:
     """Returns (logits [B, T, V], updated cache).
 
     With a cache: serves prefill (T = prompt chunk) and decode (T = 1) with
     per-sequence positions — the continuous-batching engine relies on this.
+    With ``block_table`` the cache is a :class:`PagedKVCache` pool and
+    every KV read/write goes through the table (paged serving); the cache
+    returned is the updated pool.
     Without: pure causal self-attention (training / eval); ``attn_impl``
     overrides the attention for sequence-parallel runs (ring / Ulysses).
     ``moe_impl`` overrides the MoE MLP (routed token-dispatch, meshed EP).
@@ -287,6 +332,7 @@ def forward(
             x, ck, cv = _attention_block(
                 x, lp, cfg, positions, mask, ck, cv, use_flash,
                 cache_attn_impl=cache_attn_impl,
+                block_table=block_table,
             )
         else:
             x, _, _ = _attention_block(
@@ -302,7 +348,7 @@ def forward(
 
     if cache is not None:
         x, (new_k, new_v) = lax.scan(layer_step, x, (lp_stack, cache.k, cache.v))
-        new_cache = KVCache(k=new_k, v=new_v)
+        new_cache = type(cache)(new_k, new_v)
     else:
         x, _ = lax.scan(layer_step, x, lp_stack)
         new_cache = None

@@ -91,6 +91,86 @@ def flash_attention(
     return attention_reference(q, k, v, mask=mask)
 
 
+# -- paged KV (block-table) variants ------------------------------------
+#
+# The paged arena replaces per-sequence arena rows with a global pool of
+# fixed-size pages ``[P, page_size, KV, hd]`` plus a per-lane block table
+# ``[B, n_blocks]`` of physical page ids (vLLM idiom). The ops below are
+# the single definition of the page addressing scheme: logical position
+# ``p`` of lane ``b`` lives at ``(block_table[b, p // page_size],
+# p % page_size)``. Attention gathers a lane's pages into a contiguous
+# arena VIEW and then runs the exact same math as the dense path — which
+# is what makes greedy decode bit-exact across the two layouts, and lets
+# CPU CI run the identical code (the gather lowers to plain XLA).
+
+
+def gather_pages(
+    pool_k: jnp.ndarray,  # [P, page_size, KV, hd]
+    pool_v: jnp.ndarray,
+    block_table: jnp.ndarray,  # [B, n_blocks] int32 physical page ids
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Materialize each lane's logical KV arena from its pages:
+    ``[B, n_blocks * page_size, KV, hd]`` — laid out exactly like a dense
+    arena row, so every downstream attention path applies unchanged.
+    Under a tp mesh (pool sharded on the KV-head axis) the gather is
+    local per shard: the page index never crosses the head split, so no
+    collective is needed (pinned by tests/test_paged_hlo.py)."""
+    b, nb = block_table.shape
+    ps = pool_k.shape[1]
+    k = pool_k[block_table].reshape(b, nb * ps, *pool_k.shape[2:])
+    v = pool_v[block_table].reshape(b, nb * ps, *pool_v.shape[2:])
+    return k, v
+
+
+def scatter_paged_kv(
+    pool_k: jnp.ndarray,  # [P, page_size, KV, hd]
+    pool_v: jnp.ndarray,
+    k_new: jnp.ndarray,  # [B, T, KV, hd]
+    v_new: jnp.ndarray,
+    block_table: jnp.ndarray,  # [B, n_blocks]
+    positions: jnp.ndarray,  # [B, T] int32
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Write this step's K/V through the block table into pool pages.
+
+    Positions past the logical arena (bucket padding that the dense path's
+    out-of-range scatter silently DROPS) clamp to the last logical slot —
+    the per-lane scratch row — so they land somewhere no live query ever
+    attends instead of wrapping into a live page."""
+    ps = pool_k.shape[1]
+    s = block_table.shape[1] * ps
+    cpos = jnp.minimum(positions, s - 1)
+    b_idx = jnp.arange(positions.shape[0])[:, None]
+    pages = block_table[b_idx, cpos // ps]
+    offs = cpos % ps
+    return pool_k.at[pages, offs].set(k_new), pool_v.at[pages, offs].set(v_new)
+
+
+def paged_cache_attention(
+    q: jnp.ndarray,  # [B, T, H, hd]
+    pool_k: jnp.ndarray,  # [P, page_size, KV, hd]
+    pool_v: jnp.ndarray,
+    block_table: jnp.ndarray,  # [B, n_blocks]
+    positions: jnp.ndarray,  # [B, T]
+    use_pallas: bool = True,
+) -> jnp.ndarray:
+    """Attention over a paged arena: gather the lane's pages, then dispatch
+    exactly like ``cache_attention`` (Pallas flash on TPU, XLA reference
+    elsewhere). The gathered view is bit-identical to the dense arena the
+    same tokens would have produced, so paged/dense greedy parity reduces
+    to the gather being a faithful copy."""
+    if use_pallas and _use_pallas(q.shape[2], pool_k.shape[2], q.shape[3]):
+        from .pallas_attention import paged_flash_decode, paged_flash_prefill
+
+        if q.shape[1] == 1:
+            out = paged_flash_decode(
+                q[:, 0], pool_k, pool_v, block_table, positions[:, 0]
+            )
+            return out[:, None]
+        return paged_flash_prefill(q, pool_k, pool_v, block_table, positions)
+    ck, cv = gather_pages(pool_k, pool_v, block_table)
+    return attention_reference(q, ck, cv, mask=cache_mask(positions, ck.shape[1]))
+
+
 def cache_attention(
     q: jnp.ndarray,  # [B, T, H, hd]
     ck: jnp.ndarray,  # [B, S, KV, hd] arena (slots >= positions are unwritten)

@@ -299,3 +299,44 @@ def flash_attention_tpu(q, k, v, mask=None):
     b, t = q.shape[0], q.shape[1]
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
     return flash_prefill(q, k, v, positions)
+
+
+# ---------------------------------------------------------------------------
+# paged (block-table) dispatch: pool [P, page_size, KV, hd] + block table
+# ---------------------------------------------------------------------------
+#
+# v0 strategy: gather the lane's pages into a contiguous arena view, then
+# run the SAME flash kernels above — the gather is one XLA dynamic-gather
+# that XLA overlaps with the kernel launch, and the kernels stay the
+# single masking-rule implementation both layouts share. A fused Mosaic
+# kernel that walks the block table with scalar prefetch
+# (PrefetchScalarGridSpec) and DMAs pages HBM→VMEM directly slots in
+# HERE without touching any caller: these two functions are the dispatch
+# seam. On CPU CI the gather lowers to plain XLA and the reference path
+# in ops/attention.py runs instead — identical code, identical numerics.
+
+
+def paged_flash_prefill(
+    q: jnp.ndarray,  # [B, T, H, hd]
+    pool_k: jnp.ndarray,  # [P, page_size, KV, hd]
+    pool_v: jnp.ndarray,
+    block_table: jnp.ndarray,  # [B, n_blocks] int32
+    q_positions: jnp.ndarray,  # [B, T] int32
+) -> jnp.ndarray:
+    from .attention import gather_pages  # deferred: attention.py imports us
+
+    k, v = gather_pages(pool_k, pool_v, block_table)
+    return flash_prefill(q, k, v, q_positions)
+
+
+def paged_flash_decode(
+    q: jnp.ndarray,  # [B, H, hd]
+    pool_k: jnp.ndarray,  # [P, page_size, KV, hd]
+    pool_v: jnp.ndarray,
+    block_table: jnp.ndarray,  # [B, n_blocks] int32
+    q_positions: jnp.ndarray,  # [B] int32
+) -> jnp.ndarray:
+    from .attention import gather_pages  # deferred: attention.py imports us
+
+    k, v = gather_pages(pool_k, pool_v, block_table)
+    return flash_decode(q, k, v, q_positions)

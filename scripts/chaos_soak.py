@@ -262,6 +262,41 @@ class Soak:
             )
         await self.probe_until_ok(poison_id, "poisoned_prefill")
 
+    async def phase_page_exhaustion(self, paged_id: str) -> bool:
+        """Paged-KV backpressure invariant: the paged agent runs a tiny
+        page pool AND its engine armed engine.page_alloc (count=1) from its
+        env, so both injected and ORGANIC pool exhaustion fire during this
+        phase. Every exhaustion must surface as 429/202 backpressure —
+        journal entries stay replayable (no acked loss, settled like any
+        other phase's traffic) — never a 5xx crash; the engine serves on
+        and its metrics count the exhaustions."""
+        saw_backpressure = False
+        for i in range(6):
+            # distinct sessions grow the pool toward organic exhaustion;
+            # the armed failpoint covers the deterministic half
+            status, msg = await self.chat(paged_id, session=f"pool-{i}")
+            if status >= 500:
+                self.violations.append(
+                    f"page_exhaustion: {msg} got {status} (crash, not backpressure)"
+                )
+            if status in (202, 429):
+                saw_backpressure = True
+            await asyncio.sleep(0.1)
+        # the engine must still be serving (fresh small session)
+        await self.probe_until_ok(paged_id, "page_exhaustion")
+        # engine-side accounting: the exhaustions were counted, not hidden
+        agent = self.services.manager.get_agent(paged_id)
+        stats = self.services.backend.stats(agent.engine_id) or {}
+        exhausted = int(stats.get("page_exhausted_total", 0) or 0)
+        if stats.get("paged_kv") is not True:
+            self.violations.append("page_exhaustion: agent is not serving paged KV")
+        if exhausted < 1:
+            self.violations.append(
+                "page_exhaustion: no exhaustion counted (failpoint not wired?)"
+            )
+        self.counts["page_exhausted"] = exhausted
+        return saw_backpressure and exhausted >= 1
+
     async def phase_llm_resume(self, llm_id: str) -> bool:
         """Token-identical resume: control session runs turn1+turn2 clean;
         victim session runs turn1, the engine is SIGKILLed, and after the
@@ -457,16 +492,37 @@ async def run_soak(tmpdir: str) -> dict:
             },
             env={"ATPU_FAULTS": "engine.prefill:error=RuntimeError,count=2"},
         )
+        paged_id = await soak.deploy(
+            "chaos-paged",
+            {
+                "engine": "llm",
+                "config": "tiny",
+                # paged arena with a DELIBERATELY tiny pool (6 pages = 192
+                # tokens across all sessions) so organic exhaustion joins
+                # the armed engine.page_alloc failpoint below
+                "options": {
+                    "max_batch": 1,
+                    "max_seq": 128,
+                    "prefill_chunk": 32,
+                    "paged_kv": True,
+                    "page_size": 32,
+                    "kv_pages": 6,
+                },
+            },
+            env={"ATPU_FAULTS": "engine.page_alloc:error=RuntimeError,count=1"},
+        )
 
         await soak.phase_baseline(echo_id, n_base)
         await soak.phase_engine_sigkill(echo_id)
         await soak.phase_store_blip(echo_id, n_blip)
         await soak.phase_slow_dispatch(echo_id, n_slow)
         await soak.phase_poisoned_prefill(poison_id)
+        backpressured = await soak.phase_page_exhaustion(paged_id)
         token_identical = await soak.phase_llm_resume(llm_id)
 
-        inv = await soak.settle([echo_id, poison_id, llm_id])
+        inv = await soak.settle([echo_id, poison_id, paged_id, llm_id])
         inv["token_identical_resume"] = token_identical
+        inv["page_exhaustion_backpressure"] = backpressured
     finally:
         await soak.stop()
     aof = torn_aof_check(tmpdir)

@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all native test t1 test-native test-kernels bench overload spec paged chaos server dryrun verify clean
+.PHONY: all native test t1 test-native test-kernels bench overload spec paged chaos server dryrun verify clean analyze analyze-native
 
 all: native
 
@@ -19,6 +19,28 @@ test: native
 # CPU platform, non-slow suite, DOTS_PASSED echoed for the pass floor
 t1:
 	bash -c 'set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m "not slow" --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE "^[.FEsx]+( *\[ *[0-9]+%\])?$$" /tmp/_t1.log | tr -cd . | wc -c); exit $$rc'
+
+# Invariant analysis plane (the merge gate next to t1 — docs/ANALYSIS.md):
+# 1. repo-custom AST lint (ATP001..ATP006) against the checked-in
+#    analysis/baseline.json ratchet — new violations fail, frozen ones
+#    carry per-site justifications;
+# 2. HLO contracts — never-all-gather sharding, donation aliasing, the
+#    recompile budget over a scripted mixed workload (CPU tiny model);
+# 3. analyzer self-tests (each rule's flag / don't-flag fixtures).
+# Sanitizer stress on the native store is the heavyweight leg — run it on
+# demand: `make analyze-native` (or ANALYZE_NATIVE=1 make analyze).
+analyze:
+	$(PY) -m agentainer_tpu.analysis
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_analysis.py tests/test_hlo_contracts.py \
+	  tests/test_sp_decode_hlo.py tests/test_spec_verify_hlo.py tests/test_paged_hlo.py \
+	  -q -p no:cacheprovider
+	@if [ "$(ANALYZE_NATIVE)" = "1" ]; then $(MAKE) analyze-native; fi
+	@echo "analyze: all legs passed"
+
+# sanitizer-hardened native builds + the multi-threaded store/AOF stress
+# harness under asan, tsan and ubsan (native/stress_store.cc)
+analyze-native:
+	$(MAKE) -C native sanitize
 
 test-native: native
 	$(PY) -m pytest tests/test_native.py tests/test_dataplane.py tests/test_store.py -q

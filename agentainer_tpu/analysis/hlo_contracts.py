@@ -1,0 +1,255 @@
+"""Declarative contracts over compiled HLO.
+
+The sharding invariants that keep serving fast are *compiler outputs*,
+not source properties: GSPMD may legally insert an all-gather of the KV
+arena, XLA may legally copy a "donated" buffer, a shape-key change may
+legally trigger a recompile storm. Each contract here turns one of those
+silent regressions into a loud assertion, and the ``tests/test_*_hlo.py``
+files consume these instead of re-implementing the HLO scanning (three
+copies of the same never-all-gather scan predate this module).
+
+Usage::
+
+    hlo = compile_hlo(fn, *args)
+    check(hlo, NoLargeAllGather(shard_elems), HasCrossReduction())
+    check(hlo, DonationAliased(param_indices={1}))
+
+    with recompile_budget(engine_jit_fns(engine), budget=0):
+        ...scripted mixed workload...
+"""
+
+from __future__ import annotations
+
+import re
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Callable, Iterable
+
+__all__ = [
+    "ContractViolation",
+    "NoLargeAllGather",
+    "HasCrossReduction",
+    "DonationAliased",
+    "check",
+    "compile_hlo",
+    "op_result_elems",
+    "jit_cache_size",
+    "engine_jit_fns",
+    "compile_count",
+    "recompile_budget",
+]
+
+
+class ContractViolation(AssertionError):
+    """An HLO contract failed; the message carries the offending lines."""
+
+
+_RESULT_SHAPE = re.compile(r"=\s+\w+\[([0-9,]*)\]")
+
+
+def op_result_elems(line: str) -> int:
+    """Element count of the first shaped result on an HLO text line.
+    (Factored out of test_sp_decode_hlo/test_spec_verify_hlo/test_paged_hlo
+    — the single definition all three now share.)"""
+    m = _RESULT_SHAPE.search(line)
+    if not m or not m.group(1):
+        return 0
+    n = 1
+    for d in m.group(1).split(","):
+        n *= int(d)
+    return n
+
+
+def compile_hlo(fn: Callable, *args, **kwargs) -> str:
+    """Lower + compile ``fn`` for ``args`` and return the final HLO text
+    (post-SPMD-partitioning: collectives are visible as instructions)."""
+    import jax
+
+    return jax.jit(fn).lower(*args, **kwargs).compile().as_text()
+
+
+@dataclass
+class NoLargeAllGather:
+    """No all-gather at or above ``min_elems`` result elements.
+
+    The never-all-gather invariant: under tp/sp meshes the KV arena (or
+    page pool) must stay shard-local — an all-gather the size of one
+    chip's shard means GSPMD re-materialized the whole cache and the
+    sharding is decorative. Small all-gathers (control scalars, the
+    vocab-sharded logit max) are legitimate traffic and pass.
+    """
+
+    min_elems: int
+    what: str = "the KV shard"
+
+    def failures(self, hlo: str) -> list[str]:
+        gathers = [ln for ln in hlo.splitlines() if "all-gather" in ln and "=" in ln]
+        big = [ln.strip() for ln in gathers if op_result_elems(ln) >= self.min_elems]
+        if big:
+            return [f"all-gather of {self.what} (>= {self.min_elems} elems):"] + big
+        return []
+
+
+@dataclass
+class HasCrossReduction:
+    """At least one cross-shard reduction (all-reduce / reduce-scatter)
+    exists — the sharded computation actually communicates. Zero
+    reductions means the sharding constraint was dropped and each chip
+    computed the full answer."""
+
+    def failures(self, hlo: str) -> list[str]:
+        reduces = [
+            ln
+            for ln in hlo.splitlines()
+            if ("all-reduce" in ln or "reduce-scatter" in ln) and "=" in ln
+        ]
+        if not reduces:
+            return ["no cross-shard reduction found — sharding was dropped?"]
+        return []
+
+
+_ALIAS_PARAM = re.compile(r"\(\s*(\d+)\s*,")
+
+
+def donated_params(hlo: str) -> set[int]:
+    """Parameter indices that actually alias an output in compiled HLO.
+
+    Parses the module header's ``input_output_alias={ {out}: (param,
+    {sub}, kind), ... }`` table; the braces nest, so the block is found
+    by brace counting rather than regex.
+    """
+    start = hlo.find("input_output_alias={")
+    if start < 0:
+        return set()
+    i = start + len("input_output_alias=")
+    depth = 0
+    end = i
+    for end in range(i, len(hlo)):
+        if hlo[end] == "{":
+            depth += 1
+        elif hlo[end] == "}":
+            depth -= 1
+            if depth == 0:
+                break
+    block = hlo[i : end + 1]
+    return {int(n) for n in _ALIAS_PARAM.findall(block)}
+
+
+@dataclass
+class DonationAliased:
+    """Donated buffers must actually alias in the compiled module.
+
+    ``donate_argnums`` is a *permission*, not a guarantee: when dtypes or
+    layouts mismatch, XLA silently copies instead of aliasing and the
+    engine pays double HBM for every KV arena — exactly the failure mode
+    that would erase the paged pool's capacity math. This contract reads
+    the module's ``input_output_alias`` table and demands each listed
+    parameter index appear.
+    """
+
+    param_indices: set[int] = field(default_factory=set)
+    # pytree flattening makes exact parameter indices brittle — min_count
+    # asserts "at least N parameters alias" (e.g. both KV cache leaves)
+    min_count: int = 0
+
+    def failures(self, hlo: str) -> list[str]:
+        aliased = donated_params(hlo)
+        out: list[str] = []
+        missing = sorted(set(self.param_indices) - aliased)
+        if missing:
+            out.append(
+                f"donated parameters {missing} do not alias any output "
+                f"(aliased set: {sorted(aliased)}) — XLA inserted a copy"
+            )
+        if len(aliased) < self.min_count:
+            out.append(
+                f"only {len(aliased)} parameters alias an output "
+                f"(need >= {self.min_count}) — a donated buffer is being copied"
+            )
+        return out
+
+
+def check(hlo: str, *contracts) -> None:
+    """Assert every contract against one compiled-HLO text."""
+    problems: list[str] = []
+    for c in contracts:
+        problems.extend(c.failures(hlo))
+    if problems:
+        raise ContractViolation("\n".join(problems))
+
+
+# ---------------------------------------------------------------------------
+# recompile budget
+
+
+def jit_cache_size(fn) -> int:
+    """Number of compiled variants a jitted callable holds (0 for plain
+    callables — dict-of-jit caches count their entries instead)."""
+    size = getattr(fn, "_cache_size", None)
+    if callable(size):
+        try:
+            return int(size())
+        except Exception as e:  # jax internals moved: surface, don't guess
+            raise ContractViolation(f"jit cache size unreadable: {e}") from e
+    return 0
+
+
+def engine_jit_fns(engine) -> dict[str, object]:
+    """The LLMEngine's compiled entry points, by name: the direct jit
+    handles plus every keyed compile cache (snap buckets, verify buckets,
+    prefix fork/slice buckets, paged snapshot/restore). The names are the
+    compile-key families the recompile budget is written against."""
+    fns: dict[str, object] = {}
+    for attr in ("_prefill", "_decode_n", "_inject", "_alloc_cache", "_alloc_carry"):
+        fn = getattr(engine, attr, None)
+        if fn is not None:
+            fns[attr] = fn
+    for attr in (
+        "_snap_fns",
+        "_verify_fns",
+        "_snap_paged_fns",
+        "_restore_paged_fns",
+        "_prefix_slice_fns",
+        "_prefix_fork_fns",
+    ):
+        cache = getattr(engine, attr, None)
+        if isinstance(cache, dict):
+            for key, fn in cache.items():
+                fns[f"{attr}[{key}]"] = fn
+    fn = getattr(engine, "_page_copy_fn_cached", None)
+    if fn is not None:
+        fns["_page_copy_fn_cached"] = fn
+    return fns
+
+
+def compile_count(fns: dict[str, object]) -> dict[str, int]:
+    """Per-family compiled-variant counts (dict caches count as 1 per
+    entry: each keyed fn is its own compile)."""
+    return {name: max(1, jit_cache_size(fn)) for name, fn in fns.items()}
+
+
+@contextmanager
+def recompile_budget(fns_before: Callable[[], dict[str, object]], budget: int):
+    """Fail if the scripted workload inside the block compiles more than
+    ``budget`` NEW variants across the engine's compile-key families.
+
+    Warmup is the engine's promise: decode-chunk ladder x verify buckets x
+    paged dispatch are all pre-compiled, so a steady mixed workload must
+    compile ~0 new programs. A shape-key regression (a stray non-bucketed
+    dimension reaching a jit signature) shows up here as a positive delta.
+    """
+    before = compile_count(fns_before())
+    yield
+    after = compile_count(fns_before())
+    grew = {
+        name: (before.get(name, 0), n)
+        for name, n in after.items()
+        if n > before.get(name, 0)
+    }
+    new_total = sum(n - b for b, n in grew.values())
+    if new_total > budget:
+        detail = ", ".join(f"{k}: {b}->{n}" for k, (b, n) in sorted(grew.items()))
+        raise ContractViolation(
+            f"recompile budget exceeded: {new_total} new compiled variants "
+            f"(budget {budget}) — {detail}"
+        )

@@ -128,6 +128,15 @@ def cmd_deploy(args) -> None:
         # resident sessions), --no-paged-kv pins the dense A/B baseline
         # even when the fleet default (features.paged_kv) flips on
         option_overrides["paged_kv"] = bool(getattr(args, "paged_kv", False))
+    # the remaining engine A/B options follow the --no-speculative pattern:
+    # each flag pins this agent to its baseline via the same options
+    # channel the deployment YAML uses (quad checked by ATP006)
+    if getattr(args, "no_adaptive_decode", False):
+        option_overrides["adaptive_decode"] = False
+    if getattr(args, "no_prefix_cache", False):
+        option_overrides["prefix_cache"] = False
+    if getattr(args, "no_deadlines", False):
+        option_overrides["deadlines"] = False
     if option_overrides:
         if isinstance(model, str):
             engine, _, config = model.partition(":")
@@ -435,6 +444,27 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="pin this agent's engine to the dense KV arena (the A/B "
         "baseline) even when the fleet default features.paged_kv is on",
+    )
+    s.add_argument(
+        "--no-adaptive-decode",
+        action="store_true",
+        help="pin this agent's engine to the fixed-cadence decode loop "
+        "(the pre-admission-aware A/B baseline; same as "
+        "options.adaptive_decode: false in a deployment YAML)",
+    )
+    s.add_argument(
+        "--no-prefix-cache",
+        action="store_true",
+        help="disable the cross-session prefix KV arena for this agent's "
+        "engine (every session prefills its full prompt; same as "
+        "options.prefix_cache: false in a deployment YAML)",
+    )
+    s.add_argument(
+        "--no-deadlines",
+        action="store_true",
+        help="disable engine-side deadline enforcement for this agent "
+        "(no fail-fast before prefill, no shed watermark; same as "
+        "options.deadlines: false in a deployment YAML)",
     )
     s.add_argument("--health-endpoint", default="")
     s.add_argument("--health-interval", type=float, default=30.0)

@@ -50,6 +50,12 @@ class FeatureFlags:
     # hardware-burned-in baseline; per-deployment model options override
     # (same plumbing pattern as ``speculative``).
     paged_kv: bool = False
+    # Fleet defaults for the remaining engine A/B options, completing the
+    # feature-flag quad (engine kwarg <-> deploy CLI flag <-> YAML options
+    # <-> ATPU_* env — machine-checked by analysis rule ATP006):
+    # admission-aware decode chunking and the cross-session prefix arena.
+    adaptive_decode: bool = True
+    prefix_cache: bool = True
 
 
 @dataclass
@@ -287,6 +293,24 @@ def load_config(path: str | None = None) -> Config:
     cfg.features.paged_kv = bool(feats.get("paged_kv", cfg.features.paged_kv))
     if "ATPU_PAGED_KV" in env:
         cfg.features.paged_kv = env["ATPU_PAGED_KV"].lower() in (
+            "1",
+            "true",
+            "yes",
+        )
+    cfg.features.adaptive_decode = bool(
+        feats.get("adaptive_decode", cfg.features.adaptive_decode)
+    )
+    if "ATPU_ADAPTIVE_DECODE" in env:
+        cfg.features.adaptive_decode = env["ATPU_ADAPTIVE_DECODE"].lower() in (
+            "1",
+            "true",
+            "yes",
+        )
+    cfg.features.prefix_cache = bool(
+        feats.get("prefix_cache", cfg.features.prefix_cache)
+    )
+    if "ATPU_PREFIX_CACHE" in env:
+        cfg.features.prefix_cache = env["ATPU_PREFIX_CACHE"].lower() in (
             "1",
             "true",
             "yes",

@@ -74,6 +74,13 @@ def build_services(
     # spawned engine inherits the fleet's resolved choice unless its own
     # deployment options say otherwise
     os.environ["ATPU_PAGED_KV"] = "1" if config.features.paged_kv else "0"
+    # the rest of the engine A/B quad (ATP006): adaptive decode chunking,
+    # the prefix arena, and the engine-side deadline plumbing all ship the
+    # same fleet-default channel so `features.*: false` in config.yaml is
+    # deployable without per-agent option edits
+    os.environ["ATPU_ADAPTIVE_DECODE"] = "1" if config.features.adaptive_decode else "0"
+    os.environ["ATPU_PREFIX_CACHE"] = "1" if config.features.prefix_cache else "0"
+    os.environ["ATPU_DEADLINES"] = "1" if config.deadlines.enabled else "0"
     # Fault plane: the registry and the ATPU_FAULTS env the engines inherit
     # always reflect THIS config's schedule — same write-back-the-resolved-
     # value discipline as ATPU_SPECULATIVE above: an empty spec must clear a

@@ -276,6 +276,18 @@ class LLMServeApp:
         env_paged = os.environ.get("ATPU_PAGED_KV")
         if env_paged is not None and "paged_kv" not in opts:
             opts["paged_kv"] = env_paged.lower() in ("1", "true", "yes")
+        # remaining engine A/B options ride the identical fleet-default
+        # channel (daemon write-back -> engine env -> options, per-deploy
+        # model options always winning) — the full quad per flag is
+        # machine-checked by analysis rule ATP006
+        for flag, env_name in (
+            ("adaptive_decode", "ATPU_ADAPTIVE_DECODE"),
+            ("prefix_cache", "ATPU_PREFIX_CACHE"),
+            ("deadlines", "ATPU_DEADLINES"),
+        ):
+            raw = os.environ.get(env_name)
+            if raw is not None and flag not in opts:
+                opts[flag] = raw.lower() in ("1", "true", "yes")
         if self.chips:
             # no tp injection: LLMEngine.create derives the parallelism
             # split from the chip budget itself (dense → tp-first, MoE →

@@ -99,6 +99,35 @@ class Failpoint:
         }
 
 
+# The failpoint registry-as-code: every fire()/fire_async() seam in the
+# tree, one name per cut. The static analyzer (ATP004) keeps this
+# three-way consistent with the actual call sites and with the
+# RESILIENCE.md catalog table, so a seam can't silently drop out of the
+# chaos schedule. arm() intentionally does NOT enforce membership —
+# tests arm synthetic names — but anything wired into product code
+# must be listed here.
+CATALOG: frozenset[str] = frozenset(
+    {
+        "store.get",
+        "store.set",
+        "store.cas",
+        "store.aof_flush",
+        "store_client.rpc",
+        "journal.mark_processing",
+        "journal.complete",
+        "replay.dispatch",
+        "proxy.dispatch",
+        "health.probe",
+        "engine.submit",
+        "engine.prefill",
+        "engine.decode_step",
+        "engine.snapshot",
+        "engine.page_alloc",
+        "watcher.respawn",
+    }
+)
+
+
 # The fast-path guard: fire() checks THIS dict's truthiness and returns.
 # Mutations happen under _lock; the read path relies on the GIL-atomic
 # dict read (a stale read during arm/disarm is acceptable by design).

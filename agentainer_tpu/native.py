@@ -11,6 +11,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import sys
 import threading
 from pathlib import Path
 
@@ -31,8 +32,18 @@ def _build() -> bool:
             text=True,
             timeout=300,
         )
+        if proc.returncode != 0:
+            # a silent build failure used to downgrade every daemon to the
+            # memory store with no trace — say WHY the native layer is gone
+            tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
+            print(
+                "[atpu-native] build failed (falling back to the Python "
+                "store/data plane):\n  " + "\n  ".join(tail),
+                file=sys.stderr,
+            )
         return proc.returncode == 0 and _LIB_PATH.exists()
-    except Exception:
+    except (OSError, subprocess.SubprocessError) as e:
+        print(f"[atpu-native] build not attempted: {e}", file=sys.stderr)
         return False
 
 

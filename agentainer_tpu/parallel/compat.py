@@ -1,0 +1,88 @@
+"""Version-compat seam for ``shard_map``.
+
+The parallel plane is written against the modern ``jax.shard_map`` API
+(``axis_names={...}`` for partial-manual maps, ``check_vma=`` for the
+varying-manual-axes typing check). Older jax releases (< 0.6) only ship
+``jax.experimental.shard_map.shard_map``, which spells the same concepts
+differently: partial-manual is the *complement* set ``auto=`` and the
+typing check is ``check_rep=``. Every in-repo caller imports
+:func:`shard_map` from HERE so the translation lives in exactly one place
+— the sharded planes (pipeline/expert/ring/ulysses/flash_mesh) then run,
+or cleanly skip, on both API generations instead of dying at import time.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+try:  # jax >= 0.6: first-class jax.shard_map (check_rep renamed check_vma)
+    from jax import shard_map as _new_shard_map  # type: ignore[attr-defined]
+
+    _HAS_NEW = True
+except ImportError:  # pragma: no cover - exercised on older jax only
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+    _HAS_NEW = False
+
+# Public capability flag: True when jax ships first-class jax.shard_map.
+# Tests whose lowering the OLD experimental API cannot compile safely
+# (the EP serving engine aborts inside XLA:CPU) gate on this with a skip.
+HAS_NATIVE_SHARD_MAP = _HAS_NEW
+
+
+try:  # jax >= 0.6 ships the vma cast next to shard_map
+    from jax.lax import pcast as _pcast  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover - exercised on older jax only
+    _pcast = None
+
+
+def pcast(x, axis_names, *, to: str):
+    """``jax.lax.pcast`` where it exists; identity elsewhere.
+
+    The cast only changes the varying-manual-axes TYPE of ``x`` (never its
+    value). The old shard_map has no vma typing — and the compat
+    :func:`shard_map` runs it with ``check_rep=False`` — so the identity
+    carries the same meaning there.
+    """
+    if _pcast is not None:
+        return _pcast(x, axis_names, to=to)
+    return x
+
+
+def shard_map(
+    f: Callable,
+    *,
+    mesh: Any,
+    in_specs: Any,
+    out_specs: Any,
+    axis_names: set | frozenset | None = None,
+    check_vma: bool | None = None,
+):
+    """``jax.shard_map`` with the modern keyword surface on every jax.
+
+    ``axis_names`` — mesh axes the body handles manually (partial-manual
+    map); the remaining axes stay in GSPMD's hands. ``None`` means all
+    axes are manual (the default of both underlying APIs).
+
+    ``check_vma`` — the varying/replication typing check. ``None`` keeps
+    the new API's default but DISABLES the old API's ``check_rep``: the
+    old checker predates partial-manual psum typing and rejects valid
+    bodies the numerics tests prove correct.
+    """
+    if _HAS_NEW:
+        kw: dict = {}
+        if axis_names is not None:
+            kw["axis_names"] = set(axis_names)
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        return _new_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+    kw = {"check_rep": bool(check_vma) if check_vma is not None else False}
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if auto:
+            kw["auto"] = auto
+    return _old_shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+    )

@@ -24,14 +24,12 @@ import functools as _functools
 
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.8: check_rep was renamed check_vma
-    from jax import shard_map as _shard_map
+# the kernels' per-device bodies are value-replicated by construction but
+# typed "varying" — run every map with the vma/rep check off (compat.py
+# translates check_vma to the old API's check_rep when needed)
+from .compat import shard_map as _shard_map
 
-    shard_map = _functools.partial(_shard_map, check_vma=False)
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map as _shard_map_old
-
-    shard_map = _functools.partial(_shard_map_old, check_rep=False)
+shard_map = _functools.partial(_shard_map, check_vma=False)
 
 from ..ops.pallas_attention import flash_decode, flash_prefill
 

@@ -28,7 +28,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from .compat import pcast, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.configs import ModelConfig
@@ -142,8 +142,8 @@ def make_pipeline_loss(cfg: ModelConfig, mesh: Mesh, n_microbatch: int | None = 
         emb_l = embed_lookup(embed, jnp.clip(inp - base, 0, vshard - 1))
         in_shard = ((inp >= base) & (inp < base + vshard))[..., None]
         x_all = lax.psum(jnp.where(in_shard, emb_l, 0), "pp")  # [M, mb, T, D]
-        state = lax.pcast(jnp.zeros_like(x_all[0]), ("pp",), to="varying")
-        loss0 = lax.pcast(jnp.zeros((), jnp.float32), ("pp",), to="varying")
+        state = pcast(jnp.zeros_like(x_all[0]), ("pp",), to="varying")
+        loss0 = pcast(jnp.zeros((), jnp.float32), ("pp",), to="varying")
 
         def tick(carry, ti):
             state, loss_acc = carry
@@ -269,8 +269,8 @@ def make_serve_pipeline_forward(cfg: ModelConfig, mesh: Mesh):
         x = lax.psum(jnp.where(in_shard, emb_l, 0), "pp")  # [B,T,D]
         # carries become per-stage ("varying") the moment they meet the
         # staged cache/layers — mark them so the scan types line up
-        state = lax.pcast(x, ("pp",), to="varying")
-        h_final = lax.pcast(jnp.zeros_like(x), ("pp",), to="varying")
+        state = pcast(x, ("pp",), to="varying")
+        h_final = pcast(jnp.zeros_like(x), ("pp",), to="varying")
         for t in range(pp):
             new_state, nck, ncv = _apply_stage_cached(
                 state, layers_local, cfg, positions, ck, cv

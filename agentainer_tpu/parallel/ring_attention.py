@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from .compat import pcast, shard_map
 
 NEG_INF = -1e30
 
@@ -42,9 +42,9 @@ def _ring_local(q, k, v, *, axis_name: str, causal: bool, extra_vary: tuple = ()
     # they merge with inside the scan (new shard_map vma typing); with a
     # sharded batch axis the data varies over it too
     vary = (axis_name, *extra_vary)
-    m0 = lax.pcast(jnp.full((b, kv_heads, group, t_loc), NEG_INF, jnp.float32), vary, to='varying')
-    l0 = lax.pcast(jnp.zeros((b, kv_heads, group, t_loc), jnp.float32), vary, to='varying')
-    o0 = lax.pcast(jnp.zeros((b, t_loc, kv_heads, group, hd), jnp.float32), vary, to='varying')
+    m0 = pcast(jnp.full((b, kv_heads, group, t_loc), NEG_INF, jnp.float32), vary, to='varying')
+    l0 = pcast(jnp.zeros((b, kv_heads, group, t_loc), jnp.float32), vary, to='varying')
+    o0 = pcast(jnp.zeros((b, t_loc, kv_heads, group, hd), jnp.float32), vary, to='varying')
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     def body(i, carry):

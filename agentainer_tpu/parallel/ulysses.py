@@ -16,7 +16,7 @@ from functools import partial
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from .compat import shard_map
 
 from ..ops.attention import attention_reference, causal_mask
 

@@ -157,6 +157,7 @@ class ControlPlaneApp:
         )
         self.journal_errors_total = 0
         self.journal_skipped_total = 0
+        self.abort_cancel_errors_total = 0
         self.app.on_startup.append(self._on_startup)
         self.app.on_cleanup.append(self._on_cleanup)
 
@@ -691,6 +692,7 @@ class ControlPlaneApp:
                 "store_breaker": self._store_breaker.stats(),
                 "journal_errors_total": self.journal_errors_total,
                 "journal_skipped_total": self.journal_skipped_total,
+                "abort_cancel_errors_total": self.abort_cancel_errors_total,
             }
         )
 
@@ -1131,17 +1133,33 @@ class ControlPlaneApp:
         (no waiter → replaying it is waste) and tell the engine to stop
         generating for it. Best effort on both counts."""
         if request_id:
-            try:
-                self.s.journal.mark_expired(agent_id, request_id, reason="client disconnected")
-            except Exception:
-                pass
+            # a failed dead-letter leaves the entry PROCESSING — replay's
+            # staleness reclaim re-dispatches work nobody awaits, so route
+            # it through _journal_op (breaker + journal_errors_total + a
+            # store-outage-safe warn) instead of the old silent swallow
+            self._journal_op(
+                self.s.journal.mark_expired,
+                agent_id,
+                request_id,
+                reason="client disconnected",
+            )
         try:
             agent = self.s.manager.get_agent(agent_id)
             endpoint = self.s.manager.endpoint(agent)
             if endpoint and request_id:
                 await self._cancel_on_engine(endpoint, request_id)
-        except Exception:
-            pass
+        except Exception as e:
+            # cancel is advisory (a dead engine makes it moot) but the lane
+            # keeps decoding for a vanished caller when this fails — count it
+            self.abort_cancel_errors_total += 1
+            try:
+                self.s.logs.warn(
+                    "proxy",
+                    f"engine cancel failed for {agent_id}/{request_id}: "
+                    f"{type(e).__name__}: {e}",
+                )
+            except Exception:
+                pass  # the log plane is store-backed too
         self.s.logs.info(
             "proxy", f"aborted dispatch {request_id or '<unjournaled>'} for {agent_id}: client disconnected"
         )

@@ -38,6 +38,7 @@ class MemoryStore(Store):
         self._expiry: dict[str, float] = {}
         self._subs: list[Subscription] = []
         self._callbacks: list[tuple[str, Callable[[str, str], None]]] = []
+        self.callback_errors_total = 0  # subscriber-callback failures (logged)
 
     # -- internals -------------------------------------------------------
     def _live(self, key: str) -> bool:
@@ -307,8 +308,16 @@ class MemoryStore(Store):
                 try:
                     cb(channel, message)
                     n += 1
-                except Exception:  # subscriber bugs must not break publishers
-                    pass
+                except Exception as e:
+                    # subscriber bugs must not break publishers — but they
+                    # must be visible (same log-and-count discipline as the
+                    # native store's poller)
+                    self.callback_errors_total += 1
+                    print(
+                        f"[store] subscriber callback failed for "
+                        f"{pattern!r}: {type(e).__name__}: {e}",
+                        flush=True,
+                    )
         return n
 
     def psubscribe(self, *patterns: str) -> Subscription:

@@ -123,6 +123,7 @@ class NativeStore(Store):
         )
         self._cb_threads: list[tuple[threading.Event, threading.Thread]] = []
         self._closed = False
+        self.callback_errors_total = 0  # subscriber-callback failures (logged)
         # CAS serialization: the C++ store has no native compare-and-set
         # opcode, so cas() brackets get+set under this lock. That is atomic
         # for every Python-side caller of cas() on this handle — the journal
@@ -350,8 +351,16 @@ class NativeStore(Store):
                 if got is not None:
                     try:
                         callback(*got)
-                    except Exception:  # subscriber bugs must not kill the poller
-                        pass
+                    except Exception as e:
+                        # subscriber bugs must not kill the poller — but a
+                        # silently-eaten callback error once hid a broken
+                        # watcher for a whole soak: count it and say so
+                        self.callback_errors_total += 1
+                        print(
+                            f"[store] subscriber callback failed for "
+                            f"{pattern!r}: {type(e).__name__}: {e}",
+                            flush=True,
+                        )
 
         t = threading.Thread(target=poller, daemon=True, name=f"store-sub-{pattern}")
         t.start()

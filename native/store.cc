@@ -18,6 +18,24 @@ static double now_s() {
 
 static const char* WRONGTYPE = "WRONGTYPE key holds another value type";
 
+// Timed cv wait helper. Production waits on the steady clock
+// (condition_variable::wait_for -> pthread_cond_clockwait: immune to
+// wall-clock steps). gcc-10's libtsan does not intercept clockwait
+// (gcc PR #98034), so under tsan an uninstrumented wait "leaks" the
+// mutex into the lock-held set and every later access under that lock
+// misreports as a race/double-lock — the sanitizer build waits on the
+// system clock instead (pthread_cond_timedwait, intercepted).
+template <class Rep, class Period, class Pred>
+static bool cv_timed_wait(std::condition_variable& cv,
+                          std::unique_lock<std::mutex>& lk,
+                          std::chrono::duration<Rep, Period> d, Pred pred) {
+#if defined(__SANITIZE_THREAD__)
+  return cv.wait_until(lk, std::chrono::system_clock::now() + d, pred);
+#else
+  return cv.wait_for(lk, d, pred);
+#endif
+}
+
 Store::Store(const std::string& aof_path) {
   if (!aof_path.empty()) {
     long valid = aof_load(aof_path);
@@ -501,7 +519,7 @@ int Store::sub_poll(uint64_t sub_id, int timeout_ms, std::string* channel,
   if (it == subs_.end() || it->second->closed) return -1;
   auto sub = it->second;
   if (sub->queue.empty() && timeout_ms > 0) {
-    sub_cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
+    cv_timed_wait(sub_cv_, lk, std::chrono::milliseconds(timeout_ms), [&] {
       return sub->closed || !sub->queue.empty();
     });
   }
@@ -544,7 +562,7 @@ void Store::aof_sync_loop() {
   while (!sync_stop_) {
     // steady clock via condition_variable wait_for: immune to wall-clock
     // steps (NTP), unlike a now_s()-based cadence
-    sync_cv_.wait_for(lk, std::chrono::seconds(1), [this] { return sync_stop_; });
+    cv_timed_wait(sync_cv_, lk, std::chrono::seconds(1), [this] { return sync_stop_; });
     if (sync_stop_) break;
     if (!aof_dirty_.exchange(false, std::memory_order_acq_rel)) continue;
     int fd = -1;

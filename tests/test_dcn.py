@@ -59,6 +59,15 @@ def _free_port() -> int:
 
 
 def test_two_process_dp_collective(tmp_path):
+    import jax
+
+    if tuple(int(p) for p in jax.__version__.split(".")[:2]) < (0, 5):
+        # jaxlib <= 0.4.x answers "Multiprocess computations aren't
+        # implemented on the CPU backend" at dispatch; spinning up two
+        # distributed subprocesses just to read that error costs ~40s of
+        # the tier-1 budget — skip up front on the known-unsupported range
+        # (the runtime detection below still guards newer versions)
+        pytest.skip("jaxlib CPU backend lacks multiprocess collectives (< 0.5)")
     coordinator = f"127.0.0.1:{_free_port()}"
     script = tmp_path / "worker.py"
     script.write_text(_WORKER)
@@ -85,6 +94,11 @@ def test_two_process_dp_collective(tmp_path):
     for p in procs:
         out, _ = p.communicate(timeout=150)
         outs.append(out.decode())
+    if any("Multiprocess computations aren't implemented" in out for out in outs):
+        # jaxlib's CPU backend (<= 0.4.x) refuses multiprocess collectives
+        # at dispatch time — the distributed init and mesh construction
+        # above still ran; only the cross-process execution is unsupported
+        pytest.skip("jaxlib CPU backend lacks multiprocess collectives")
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out}"
         assert "cross-process sum OK" in out

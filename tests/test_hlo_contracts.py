@@ -1,0 +1,175 @@
+"""HLO contracts (analysis/hlo_contracts.py): donation aliasing and the
+recompile budget, checked against the REAL tiny engine on CPU.
+
+Two invariants that only exist in compiler output:
+
+- ``donate_argnums`` is a permission, not a guarantee — XLA silently
+  copies when it can't alias, doubling KV HBM. The contract reads the
+  compiled module's ``input_output_alias`` table.
+- warmup's promise is that a steady mixed workload (decode ladder x
+  verify buckets x paged dispatch) compiles NOTHING new; a stray
+  non-bucketed dimension reaching a jit signature breaks that silently.
+  ``recompile_budget`` counts compiled variants across the engine's
+  compile-key families before/after a scripted workload.
+
+The never-all-gather contracts are covered where they always were —
+tests/test_sp_decode_hlo.py / test_spec_verify_hlo.py / test_paged_hlo.py
+now consume the same module instead of three copies of the scan.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from agentainer_tpu.analysis.hlo_contracts import (
+    ContractViolation,
+    DonationAliased,
+    HasCrossReduction,
+    NoLargeAllGather,
+    check,
+    compile_count,
+    donated_params,
+    engine_jit_fns,
+    op_result_elems,
+    recompile_budget,
+)
+from agentainer_tpu.engine.llm import LLMEngine
+
+
+@pytest.fixture(scope="module")
+def engine():
+    """One shared paged+speculative tiny engine: the configuration whose
+    compile-key space is the largest (block tables, verify ladder, CoW)."""
+    eng = LLMEngine.create(
+        "tiny",
+        options={
+            "max_batch": 4,
+            "max_seq": 256,
+            "decode_chunk": 8,
+            "prefill_chunk": 32,
+            "paged_kv": True,
+            "speculative": True,
+        },
+    )
+    yield eng
+    eng.shutdown()
+
+
+def _gen(engine, prompt, n=6, session=""):
+    async def go():
+        return await engine.generate(prompt, max_tokens=n, session=session)
+
+    return asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# unit-level: the text scanners
+
+
+def test_op_result_elems_parses_shapes():
+    assert op_result_elems("  %ag = f32[2,64,2,16]{3,2,1,0} all-gather(...)") == 2 * 64 * 2 * 16
+    assert op_result_elems("  %t = pred[] compare(...)") == 0
+    assert op_result_elems("no shape here") == 0
+
+
+def test_no_large_all_gather_flags_only_big_ops():
+    hlo = "\n".join(
+        [
+            "%small = f32[8]{0} all-gather(%x)",
+            "%big = f32[2,64,2,16]{3,2,1,0} all-gather(%y)",
+        ]
+    )
+    assert NoLargeAllGather(min_elems=4096).failures(hlo)
+    assert not NoLargeAllGather(min_elems=10_000).failures(hlo)
+    with pytest.raises(ContractViolation):
+        check(hlo, NoLargeAllGather(min_elems=4096))
+
+
+def test_has_cross_reduction_contract():
+    assert HasCrossReduction().failures("%x = f32[4]{0} add(%a, %b)")
+    assert not HasCrossReduction().failures("%r = f32[4]{0} all-reduce(%a)")
+
+
+# ---------------------------------------------------------------------------
+# donation aliasing
+
+
+def test_donated_buffer_aliases_in_simple_jit():
+    f = jax.jit(lambda c: c * 2.0, donate_argnums=(0,))
+    hlo = f.lower(jnp.ones((64, 64), jnp.float32)).compile().as_text()
+    assert donated_params(hlo), "same-shape donation should alias"
+    check(hlo, DonationAliased(min_count=1))
+
+
+def test_donation_contract_catches_silent_copy():
+    """dtype-narrowing donation CANNOT alias (4-byte f32 rows into 2-byte
+    bf16 rows) — XLA copies silently; the contract must fail loudly."""
+    f = jax.jit(lambda c: c.astype(jnp.bfloat16), donate_argnums=(0,))
+    hlo = f.lower(jnp.ones((64, 64), jnp.float32)).compile().as_text()
+    assert not donated_params(hlo)
+    with pytest.raises(ContractViolation, match="donated"):
+        check(hlo, DonationAliased(min_count=1))
+
+
+def test_engine_prefill_donation_actually_aliases(engine):
+    """The serving prefill donates the KV cache (donate_argnums=(1,)):
+    both pool leaves (k and v) must alias outputs in the compiled module,
+    or every prefill pays a full arena copy in HBM."""
+    b = 8  # smallest prefill bucket
+    tokens = jnp.zeros((1, b), jnp.int32)
+    pos = jnp.zeros((1, b), jnp.int32)
+    hlo = (
+        engine._prefill.lower(
+            engine.params,
+            engine.cache,
+            jnp.asarray(engine._bt[0:1]),
+            tokens,
+            pos,
+            jnp.int32(4),
+        )
+        .compile()
+        .as_text()
+    )
+    check(hlo, DonationAliased(min_count=2))
+
+
+# ---------------------------------------------------------------------------
+# recompile budget over the scripted mixed workload
+
+
+JSON_LOOP = '{"tool": "search", "args": {"q": "w", "n": 5}}\n' * 4
+PERSONA = "You are a terse assistant. Answer in one word. " * 4
+
+
+def test_recompile_budget_mixed_workload(engine):
+    """decode ladder x verify buckets x paged dispatch, zero new compiles.
+
+    Warmup compiled every reachable signature; this scripted workload
+    re-exercises them all through the public API. Any positive delta in
+    the engine's compile caches is a shape-key regression.
+    """
+    # settle any lazily-keyed fns the fixture's first use could create
+    _gen(engine, "hello", n=2)
+
+    families = lambda: engine_jit_fns(engine)  # noqa: E731
+    with recompile_budget(families, budget=0):
+        # prefill buckets: prompts landing in buckets 8/16/32
+        for words in (2, 9, 20):
+            _gen(engine, "tok " * words, n=2)
+        # decode ladder rungs: max_tokens = c+1 picks rung c
+        for c in (1, 2, 4, 8):
+            _gen(engine, "ladder probe", n=c + 1)
+        # verify buckets: repetitive JSON drives prompt-lookup speculation
+        _gen(engine, JSON_LOOP, n=24)
+        # paged prefix sharing + CoW tail: two sessions, same persona
+        _gen(engine, PERSONA + "What is two plus two?", n=4, session="hc-a")
+        _gen(engine, PERSONA + "Name a color.", n=4, session="hc-b")
+        # multi-turn on a resident paged session (block-table growth path)
+        _gen(engine, "and another thing", n=4, session="hc-a")
+
+    # sanity: the families we budget over actually exist on this engine
+    counts = compile_count(engine_jit_fns(engine))
+    assert any(k.startswith("_verify_fns") for k in counts), counts
+    assert "_prefill" in counts and "_decode_n" in counts

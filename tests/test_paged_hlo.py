@@ -11,14 +11,13 @@ with the whole arena and erase paged serving's point. These tests compile
 the real paged attention body under a tp mesh and assert on the HLO text.
 """
 
-import re
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from agentainer_tpu.analysis.hlo_contracts import NoLargeAllGather, check
 from agentainer_tpu.ops.attention import (
     attention_reference,
     cache_mask,
@@ -39,16 +38,6 @@ POOL = B * NB + 2  # physical pages
 S = NB * PS
 T = 5  # verify-shaped call: t = K+1 tokens per lane
 SHARD_ELEMS = POOL * PS * (KV // 2) * HD  # one chip's pool shard
-
-
-def _op_result_elems(line: str) -> int:
-    m = re.search(r"=\s+\w+\[([0-9,]*)\]", line)
-    if not m or not m.group(1):
-        return 0
-    n = 1
-    for d in m.group(1).split(","):
-        n *= int(d)
-    return n
 
 
 def _paged_attention(q, k_new, v_new, pool_k, pool_v, bt, positions):
@@ -92,9 +81,7 @@ def test_tp_paged_gather_keeps_pool_shard_local():
     mesh = make_mesh(2, tp=2)
     args = _device_put_tp(_inputs(), mesh)
     hlo = jax.jit(_paged_attention).lower(*args).compile().as_text()
-    gathers = [ln for ln in hlo.splitlines() if "all-gather" in ln and "=" in ln]
-    big = [ln for ln in gathers if _op_result_elems(ln) >= SHARD_ELEMS]
-    assert not big, "tp paged attention all-gathers the KV pool:\n" + "\n".join(big)
+    check(hlo, NoLargeAllGather(SHARD_ELEMS, what="the paged KV pool shard"))
 
 
 def test_tp_paged_numerics_match_unsharded():

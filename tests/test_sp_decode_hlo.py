@@ -10,14 +10,17 @@ HLO text: every all-gather (if any) is small control traffic, never the
 cache shard; at least one cross-sp reduction exists.
 """
 
-import re
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from agentainer_tpu.analysis.hlo_contracts import (
+    HasCrossReduction,
+    NoLargeAllGather,
+    check,
+)
 from agentainer_tpu.ops.attention import attention_reference, cache_mask
 from agentainer_tpu.parallel.mesh import make_mesh
 
@@ -28,17 +31,6 @@ pytestmark = pytest.mark.skipif(
 B, S, KV, G, HD = 2, 64, 2, 2, 16
 H = KV * G
 SHARD_ELEMS = B * S * KV * HD // 2  # one chip's cache shard
-
-
-def _op_result_elems(line: str) -> int:
-    """Element count of the first shaped result on an HLO text line."""
-    m = re.search(r"=\s+\w+\[([0-9,]*)\]", line)
-    if not m or not m.group(1):
-        return 0
-    n = 1
-    for d in m.group(1).split(","):
-        n *= int(d)
-    return n
 
 
 def _compile_decode(sp: int):
@@ -59,15 +51,11 @@ def _compile_decode(sp: int):
 
 def test_sp_decode_reduces_instead_of_gathering_kv():
     hlo = _compile_decode(2)
-    gathers = [ln for ln in hlo.splitlines() if "all-gather" in ln and "=" in ln]
-    big = [ln for ln in gathers if _op_result_elems(ln) >= SHARD_ELEMS]
-    assert not big, f"sp decode all-gathers the KV shard:\n" + "\n".join(big)
-    reduces = [
-        ln
-        for ln in hlo.splitlines()
-        if ("all-reduce" in ln or "reduce-scatter" in ln) and "=" in ln
-    ]
-    assert reduces, "no cross-sp reduction found — sharding was dropped?"
+    check(
+        hlo,
+        NoLargeAllGather(SHARD_ELEMS, what="the sp KV shard"),
+        HasCrossReduction(),
+    )
 
 
 def test_sp_decode_numerics_match_unsharded():

@@ -11,14 +11,13 @@ computation (scatter the k+1 new KV rows, attend with the position mask)
 under a tp mesh and assert on the HLO text.
 """
 
-import re
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from agentainer_tpu.analysis.hlo_contracts import NoLargeAllGather, check
 from agentainer_tpu.ops.attention import attention_reference, cache_mask
 from agentainer_tpu.parallel.mesh import make_mesh
 
@@ -30,16 +29,6 @@ B, S, KV, G, HD = 2, 64, 2, 2, 16
 H = KV * G
 K = 4  # draft bucket: verify feeds t = K+1 tokens per lane
 SHARD_ELEMS = B * S * (KV // 2) * HD  # one chip's cache shard
-
-
-def _op_result_elems(line: str) -> int:
-    m = re.search(r"=\s+\w+\[([0-9,]*)\]", line)
-    if not m or not m.group(1):
-        return 0
-    n = 1
-    for d in m.group(1).split(","):
-        n *= int(d)
-    return n
 
 
 def _verify_attention(q, k_new, v_new, ck, cv, positions):
@@ -71,9 +60,7 @@ def _compile_verify(tp: int) -> str:
 
 def test_tp_verify_keeps_kv_shard_local():
     hlo = _compile_verify(2)
-    gathers = [ln for ln in hlo.splitlines() if "all-gather" in ln and "=" in ln]
-    big = [ln for ln in gathers if _op_result_elems(ln) >= SHARD_ELEMS]
-    assert not big, "tp verify all-gathers the KV shard:\n" + "\n".join(big)
+    check(hlo, NoLargeAllGather(SHARD_ELEMS, what="the tp verify KV shard"))
 
 
 def test_tp_verify_numerics_match_unsharded():

@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all native test t1 test-native test-kernels bench overload spec paged chaos server dryrun verify clean analyze analyze-native
+.PHONY: all native test t1 test-native test-kernels bench overload spec paged fleet chaos server dryrun verify clean analyze analyze-native
 
 all: native
 
@@ -70,9 +70,17 @@ spec:
 paged:
 	JAX_PLATFORMS=cpu $(PY) scripts/bench_paged.py
 
+# fleet bench (smoke): goodput + p99 TTFT at replicas 1/2/4 (echo), 2-replica
+# failover MTTR under steady probes, and mid-decode token-identical resume
+# on a surviving LLM replica; writes BENCH_fleet.json. Full run drops
+# ATPU_FLEET_SMOKE
+fleet:
+	JAX_PLATFORMS=cpu ATPU_FLEET_SMOKE=1 $(PY) scripts/bench_fleet.py
+
 # chaos soak: live daemon + engine subprocesses through the seeded fault
 # schedule (store blips, SIGKILLs, slow dispatch, torn AOF, poisoned
-# prefill); asserts the durability invariants and writes BENCH_chaos.json.
+# prefill, replica-fleet failover/lease-flap/stale-routing phases);
+# asserts the durability invariants and writes BENCH_chaos.json.
 # Fixed seed -> reproducible schedule; full run drops ATPU_CHAOS_SMOKE
 chaos:
 	JAX_PLATFORMS=cpu ATPU_CHAOS_SEED=1337 ATPU_CHAOS_SMOKE=1 $(PY) scripts/chaos_soak.py

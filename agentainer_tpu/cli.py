@@ -92,6 +92,7 @@ def cmd_deploy(args) -> None:
                         "resources": s.resources.to_dict(),
                         "auto_restart": s.auto_restart,
                         "health_check": s.health_check.to_dict() if s.health_check else None,
+                        "replicas": s.engine_replicas,
                     },
                 )
                 agent = doc["data"]
@@ -149,6 +150,8 @@ def cmd_deploy(args) -> None:
         "resources": {"chips": args.chips, "hbm_bytes": args.hbm_bytes},
         "auto_restart": args.auto_restart,
     }
+    if getattr(args, "replicas", 0):
+        body["replicas"] = args.replicas
     if args.health_endpoint:
         body["health_check"] = {
             "endpoint": args.health_endpoint,
@@ -420,6 +423,14 @@ def build_parser() -> argparse.ArgumentParser:
         "artifact, and serves it with the llm engine",
     )
     s.add_argument("--env", action="append", default=[], metavar="KEY=VALUE")
+    s.add_argument(
+        "--replicas",
+        type=int,
+        default=0,
+        help="engine replicas for this agent (fleet: health-aware routing, "
+        "mid-decode failover, token-identical session resume on a "
+        "survivor); 0 = the daemon's fleet.replicas default",
+    )
     s.add_argument("--chips", type=int, default=1)
     s.add_argument("--hbm-bytes", type=int, default=8 * 1024**3)
     s.add_argument("--auto-restart", action="store_true")

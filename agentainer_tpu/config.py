@@ -84,6 +84,38 @@ class DeadlineConfig:
 
 
 @dataclass
+class FleetConfig:
+    """Replica fleet: N engine replicas per agent behind the routing tier.
+
+    ``replicas: 1`` (the default) is the pre-fleet behavior exactly — one
+    engine per agent, no routing tier, no lease monitor traffic — and is
+    the A/B baseline. With N > 1 each replica is its own failure domain
+    (own process, own port, own crash-loop watcher); sessions are routed
+    with KV-residency affinity, fresh sessions by power-of-two-choices on
+    in-flight depth, and a dead replica's sessions fail over to a survivor
+    via the store-durable KV snapshot (token-identical resume). Per-deploy
+    ``replicas`` in the agent body overrides the fleet default."""
+
+    replicas: int = 1
+    # replica heartbeat lease: the monitor probes each replica every
+    # lease_interval_s and refreshes a store lease with lease_ttl_s; a
+    # replica whose lease is older than suspect_after_s is SUSPECT
+    # (excluded from routing), older than dead_after_s is DEAD (repaired)
+    lease_ttl_s: float = 6.0
+    lease_interval_s: float = 1.0
+    suspect_after_s: float = 3.0
+    dead_after_s: float = 6.0
+    # bounded cross-replica retry for connection-level dispatch failures
+    # (nothing executed on the dead replica, and the journal CAS admits
+    # exactly one dispatcher, so the retry cannot double-execute)
+    retry_next_replica: int = 2
+    # per-replica circuit breaker (one bad replica must not open a breaker
+    # for the whole agent)
+    breaker_failures: int = 3
+    breaker_cooldown_s: float = 2.0
+
+
+@dataclass
 class ResilienceConfig:
     """Crash-loop backoff, store-outage degradation, and fault injection.
 
@@ -129,6 +161,7 @@ class Config:
     cadences: Cadences = field(default_factory=Cadences)
     deadlines: DeadlineConfig = field(default_factory=DeadlineConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
     auth_token: str = DEFAULT_TOKEN
     # "auto": native C++ store with AOF durability when the library builds,
     # in-memory store otherwise. Explicit: mem:// | native://[aof-path]
@@ -206,6 +239,25 @@ def load_config(path: str | None = None) -> Config:
         res.get("breaker_cooldown_s", cfg.resilience.breaker_cooldown_s)
     )
     cfg.resilience.faults = str(res.get("faults", cfg.resilience.faults))
+    fl = doc.get("fleet", {})
+    cfg.fleet.replicas = int(fl.get("replicas", cfg.fleet.replicas))
+    cfg.fleet.lease_ttl_s = float(fl.get("lease_ttl_s", cfg.fleet.lease_ttl_s))
+    cfg.fleet.lease_interval_s = float(
+        fl.get("lease_interval_s", cfg.fleet.lease_interval_s)
+    )
+    cfg.fleet.suspect_after_s = float(
+        fl.get("suspect_after_s", cfg.fleet.suspect_after_s)
+    )
+    cfg.fleet.dead_after_s = float(fl.get("dead_after_s", cfg.fleet.dead_after_s))
+    cfg.fleet.retry_next_replica = int(
+        fl.get("retry_next_replica", cfg.fleet.retry_next_replica)
+    )
+    cfg.fleet.breaker_failures = int(
+        fl.get("breaker_failures", cfg.fleet.breaker_failures)
+    )
+    cfg.fleet.breaker_cooldown_s = float(
+        fl.get("breaker_cooldown_s", cfg.fleet.breaker_cooldown_s)
+    )
     sec = doc.get("security", {})
     cfg.auth_token = sec.get("auth_token", cfg.auth_token)
     cfg.store_url = doc.get("store", {}).get("url", cfg.store_url)
@@ -246,6 +298,14 @@ def load_config(path: str | None = None) -> Config:
             "true",
             "yes",
         )
+    if "ATPU_FLEET_REPLICAS" in env:
+        # the env bind completes the fleet flag's operator surface
+        # (config.yaml `fleet.replicas` / per-deploy `replicas` / env):
+        # malformed values fall back like the other numeric binds
+        try:
+            cfg.fleet.replicas = int(env["ATPU_FLEET_REPLICAS"])
+        except ValueError:
+            pass
     if "ATPU_FAULTS" in env:
         # the env spec REPLACES a config-file spec rather than merging:
         # an operator arming from the shell must get exactly that schedule

@@ -94,6 +94,53 @@ class CircuitBreaker:
             }
 
 
+class KeyedBreakers:
+    """A family of independent CircuitBreakers keyed by string (one per
+    engine replica): a replica that keeps failing opens ITS breaker only,
+    so the agent's other replicas keep serving — the whole point of the
+    per-replica split versus one breaker per agent."""
+
+    def __init__(self, failure_threshold: int = 3, cooldown_s: float = 2.0):
+        self.failure_threshold = failure_threshold
+        self.cooldown_s = cooldown_s
+        self._lock = threading.Lock()
+        self._breakers: dict[str, CircuitBreaker] = {}
+
+    def get(self, key: str) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(key)
+            if br is None:
+                br = self._breakers[key] = CircuitBreaker(
+                    failure_threshold=self.failure_threshold,
+                    cooldown_s=self.cooldown_s,
+                )
+            return br
+
+    def drop(self, key: str) -> None:
+        """Forget a replaced/removed replica's breaker (a respawned engine
+        gets a fresh id, so stale entries would only leak)."""
+        with self._lock:
+            self._breakers.pop(key, None)
+
+    def stats(self) -> dict[str, dict]:
+        with self._lock:
+            items = list(self._breakers.items())
+        return {key: br.stats() for key, br in items}
+
+
+def retry_after_jitter(
+    base_s: float, rng: random.Random | None = None, spread: float = 0.5
+) -> int:
+    """Retry-After seconds with multiplicative jitter in [1-spread/2,
+    1+spread/2): a fleet of clients shed in the same instant must NOT come
+    back in the same instant — synchronized retries re-stampede exactly
+    the replica that was recovering. Pass a seeded ``rng`` for a
+    deterministic sequence (tests, chaos). Result is a whole second >= 1
+    (the HTTP header is integer seconds)."""
+    r = rng or random
+    return max(1, int(round(base_s * (1.0 - spread / 2 + spread * r.random()))))
+
+
 def backoff_delays(
     retries: int,
     base_s: float = 0.05,

@@ -146,6 +146,14 @@ class Agent:
     model: ModelRef
     status: AgentStatus = AgentStatus.CREATED
     engine_id: str = ""
+    # replica fleet: every engine serving this agent, primary first.
+    # ``engine_id`` stays the primary replica's id (replica_ids[0]) so
+    # every pre-fleet reader keeps working; single-replica agents may
+    # leave this empty (engine_id alone is authoritative then).
+    replica_ids: list[str] = field(default_factory=list)
+    # engine replicas for this agent: 0 = use the fleet default
+    # (config fleet.replicas); >= 1 pins this agent explicitly
+    replicas: int = 0
     env: dict[str, str] = field(default_factory=dict)
     resources: Resources = field(default_factory=Resources)
     auto_restart: bool = False
@@ -161,6 +169,8 @@ class Agent:
             "model": self.model.to_dict(),
             "status": self.status.value,
             "engine_id": self.engine_id,
+            "replica_ids": list(self.replica_ids),
+            "replicas": self.replicas,
             "env": dict(self.env),
             "resources": self.resources.to_dict(),
             "auto_restart": self.auto_restart,
@@ -178,6 +188,8 @@ class Agent:
             model=ModelRef.from_dict(d.get("model")),
             status=AgentStatus(d.get("status", "created")),
             engine_id=d.get("engine_id", ""),
+            replica_ids=list(d.get("replica_ids", []) or []),
+            replicas=int(d.get("replicas", 0) or 0),
             env=dict(d.get("env", {})),
             resources=Resources.from_dict(d.get("resources")),
             auto_restart=bool(d.get("auto_restart", False)),
@@ -186,6 +198,13 @@ class Agent:
             created_at=float(d.get("created_at", 0.0)),
             updated_at=float(d.get("updated_at", 0.0)),
         )
+
+    def all_engine_ids(self) -> list[str]:
+        """Every engine serving this agent, primary first. Single-replica
+        records predate ``replica_ids``, so fall back to ``engine_id``."""
+        if self.replica_ids:
+            return list(self.replica_ids)
+        return [self.engine_id] if self.engine_id else []
 
 
 def new_agent_id() -> str:

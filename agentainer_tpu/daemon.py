@@ -47,6 +47,11 @@ class Services:
     quick_sync: QuickSync = None  # type: ignore[assignment]
     state_sync: StateSynchronizer = None  # type: ignore[assignment]
     replay: ReplayWorker = None  # type: ignore[assignment]
+    # fleet plane (multi-replica agents): the proxy's routing tier, the
+    # lease-driven replica monitor, and the dead-replica repair path
+    router: object = None
+    replica_monitor: object = None
+    fleet_repair: object = None
     dispatch: Callable[..., Awaitable[tuple[int, dict, bytes]]] = None  # type: ignore[assignment]
     dataplane: object = None  # NativeDataPlane when the C++ listener is up
     public_port: int = 0  # actual bound public port once run_daemon is up
@@ -183,6 +188,29 @@ def build_services(
         interval_s=config.cadences.replay_scan_s,
         backend=backend,
     )
+
+    # fleet plane: replica leases + fleet-wide repair. The monitor only
+    # probes agents with >1 replica, so a fleet.replicas=1 deployment runs
+    # zero extra traffic (the A/B baseline).
+    from .manager.health import ReplicaMonitor
+    from .manager.reconcile import FleetRepair
+
+    manager.set_fleet(config.fleet.replicas, config.fleet.lease_ttl_s)
+    services.router = app_obj.router
+    services.fleet_repair = FleetRepair(
+        manager, journal, router=app_obj.router, replay=services.replay, logs=logs
+    )
+    services.replica_monitor = ReplicaMonitor(
+        manager,
+        store,
+        router=app_obj.router,
+        repair=services.fleet_repair,
+        lease_ttl_s=config.fleet.lease_ttl_s,
+        lease_interval_s=config.fleet.lease_interval_s,
+        suspect_after_s=config.fleet.suspect_after_s,
+        dead_after_s=config.fleet.dead_after_s,
+        logs=logs,
+    )
     return services
 
 
@@ -197,12 +225,16 @@ async def start_background(services: Services) -> None:
         await services.replay.start()
     await services.metrics.start()
     await services.health.start()
+    if services.replica_monitor is not None:
+        await services.replica_monitor.start()
 
 
 async def stop_background(services: Services) -> None:
     if not services._background_started:
         return
     services._background_started = False
+    if services.replica_monitor is not None:
+        await services.replica_monitor.stop()
     await services.replay.stop()
     await services.state_sync.stop()
     await services.metrics.stop()
@@ -245,9 +277,18 @@ def _try_start_dataplane(services: Services, mgmt_port: int):
         if agent is None:
             dp.route_del(agent_id)
         else:
+            endpoint = services.manager.endpoint(agent)
+            if len(agent.all_engine_ids()) > 1:
+                # replica fleet: no single endpoint is correct — install a
+                # python-owned route (port 0) so the C++ front door hands
+                # /agent/* for this agent to the aiohttp proxy, where the
+                # routing tier (affinity, health exclusion, bounded
+                # cross-replica retry) owns the dispatch. Single-replica
+                # agents keep the zero-Python native fast path.
+                endpoint = None
             dp.route_set(
                 agent_id,
-                services.manager.endpoint(agent),
+                endpoint,
                 agent.status.value,
                 persist,
             )

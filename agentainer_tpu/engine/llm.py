@@ -39,6 +39,17 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+# Partitionable threefry, set before any engine program is traced: the
+# legacy (non-partitionable) implementation computes WRONG values when a
+# random-init is jitted with out_shardings over a mesh with more than one
+# nontrivial axis and a spec that uses only a subset of them (jax 0.4.37:
+# P("tp", None) on a tp×sp mesh silently corrupts the embed table — the
+# tp×sp engine decoded garbage while tp-only and sp-only were fine).
+# Partitionable threefry is sharding-invariant by construction. It changes
+# the random stream, so every in-process engine/model comparison shares
+# the new stream; no test pins absolute values from the old one.
+jax.config.update("jax_threefry_partitionable", True)
+
 from .. import faults
 from ..models.configs import ModelConfig, get_config
 from ..models.llama import KVCache, PagedKVCache, forward, init_params

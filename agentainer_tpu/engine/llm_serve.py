@@ -89,6 +89,13 @@ class LLMServeApp:
         self.chips = tuple(
             int(c) for c in E.get("AGENTAINER_CHIPS", "0").split(",") if c != ""
         )
+        # fleet replica ordinal (0 for single-replica agents): pure
+        # observability — lets operators attribute traffic/restarts to one
+        # replica in /metrics and logs
+        try:
+            self.replica = int(E.get("AGENTAINER_REPLICA", "0") or 0)
+        except ValueError:
+            self.replica = 0
         self.store = StoreClient(
             control_url=E.get("AGENTAINER_CONTROL_URL", ""),
             token=E.get("AGENTAINER_INTERNAL_TOKEN", ""),
@@ -724,6 +731,11 @@ class LLMServeApp:
         dl_kw = (
             {"deadline_at": dl} if (dl := self._deadline_from(request)) is not None else {}
         )
+        # fixed-length streams on request (benchmarks and the chaos soak's
+        # mid-decode kill need a decode window that doesn't end at a tiny
+        # model's early EOS); kwarg-only-when-set, same as deadline_at
+        if body.get("ignore_eos"):
+            dl_kw["ignore_eos"] = True
 
         if self.flatten_history:
             # gemini-agent-style turn: persona + last-N exchanges flattened
@@ -996,6 +1008,7 @@ class LLMServeApp:
         doc = {
             "engine": "llm",
             "model": self.config_name,
+            "replica": self.replica,
             "requests_total": self.requests_total,
             "uptime_s": time.time() - self.started_at,
             "model_loaded": self.engine is not None,

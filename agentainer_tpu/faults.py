@@ -124,6 +124,14 @@ CATALOG: frozenset[str] = frozenset(
         "engine.snapshot",
         "engine.page_alloc",
         "watcher.respawn",
+        # fleet seams: the routing tier's replica choice (firing = a stale
+        # routing table hands back a dead replica), the replica heartbeat
+        # lease refresh (firing = a healthy replica's lease lapses → SUSPECT
+        # flapping), and the session-affinity handoff off a dead replica
+        # (firing = the session stays pinned to the corpse one more dispatch)
+        "router.pick",
+        "replica.lease",
+        "replica.handoff",
     }
 )
 

@@ -42,6 +42,23 @@ class AgentManager:
         self._lock = threading.RLock()
         self._quick_sync = None  # wired by services.py to avoid an import cycle
         self._route_hook = None  # native data plane routing-table feed
+        # fleet defaults (config fleet.*, set by build_services): how many
+        # engine replicas a start spawns when the agent record doesn't pin
+        # its own count, and the TTL of the initial replica lease
+        self.fleet_replicas = 1
+        self.lease_ttl_s = 6.0
+        # fleet bookkeeping failures are best-effort but never silent
+        self.lease_register_errors_total = 0
+        self.replica_scaledown_errors_total = 0
+
+    def set_fleet(self, replicas: int, lease_ttl_s: float) -> None:
+        self.fleet_replicas = max(1, int(replicas))
+        self.lease_ttl_s = float(lease_ttl_s)
+
+    def replica_count(self, agent: Agent) -> int:
+        """Desired replicas for this agent: the record's own pin wins,
+        else the fleet default."""
+        return max(1, int(agent.replicas or self.fleet_replicas))
 
     def set_quick_sync(self, quick_sync) -> None:
         self._quick_sync = quick_sync
@@ -112,10 +129,13 @@ class AgentManager:
         auto_restart: bool = False,
         token: str = "",
         health_check: HealthCheckConfig | None = None,
+        replicas: int = 0,
     ) -> Agent:
         if not name or len(name) > 64:
             # input validation parity: name required, ≤64 chars (server.go:157-179)
             raise InvalidInput("agent name must be 1-64 characters")
+        if replicas < 0 or replicas > 64:
+            raise InvalidInput("replicas must be 0 (fleet default) to 64")
         ref = model if isinstance(model, ModelRef) else ModelRef.from_dict(model)
         self._validate_model(ref)
         agent = Agent(
@@ -127,6 +147,7 @@ class AgentManager:
             auto_restart=auto_restart,
             token=token,
             health_check=health_check,
+            replicas=int(replicas),
         )
         with self._lock:
             self.save_agent(agent)
@@ -174,24 +195,78 @@ class AgentManager:
         return agent
 
     def _start_engine(self, agent: Agent) -> None:
-        """Create-or-start, parity with agent.go:154-164."""
-        info = self.backend.engine_info(agent.engine_id) if agent.engine_id else None
-        if info is None:
+        """Create-or-start every replica, parity with agent.go:154-164.
+
+        The single-replica path is the pre-fleet behavior exactly: one
+        engine, ``replica_ids`` mirrors ``engine_id``. With N > 1 each
+        replica is created with its own ordinal (its own process/failure
+        domain in the backend) over the agent's one chip placement, and a
+        fresh lease is registered so the replica monitor starts from an
+        ALIVE view instead of a cold SUSPECT window."""
+        n = self.replica_count(agent)
+        live = [
+            eid for eid in agent.all_engine_ids() if self.backend.engine_info(eid)
+        ]
+        if len(live) < n:
             from ..engine import is_tpu_engine
 
             # JAX-backed flavors sharing a model config share weight HBM
             share_group = agent.model.config if is_tpu_engine(agent.model.engine) else ""
-            placement = self.scheduler.allocate(agent, share_group=share_group)
-            agent.engine_id = self.backend.create_engine(agent, placement.chips)
-        self.backend.start_engine(agent.engine_id)
+            placement = self.scheduler.placement(agent.id) or self.scheduler.allocate(
+                agent, share_group=share_group
+            )
+            for i in range(len(live), n):
+                live.append(
+                    self.backend.create_engine(
+                        agent, placement.chips, replica_index=i
+                    )
+                )
+        # scale-down (operator lowered the count): surplus replicas stop
+        for eid in live[n:]:
+            try:
+                self.backend.stop_engine(eid, timeout_s=5.0)
+                self.backend.remove_engine(eid)
+            except Exception as e:
+                # a stuck surplus replica must not block the start; counted
+                # so a leak is visible, and the reconciler's orphan sweep
+                # remains the net
+                self.replica_scaledown_errors_total += 1
+                print(
+                    f"[manager] scale-down of replica {eid} failed: {e!r}",
+                    flush=True,
+                )
+        live = live[:n]
+        agent.engine_id = live[0]
+        agent.replica_ids = list(live) if n > 1 else []
+        for eid in live:
+            self.backend.start_engine(eid)
+        if n > 1:
+            self._register_leases(agent)
+
+    def _register_leases(self, agent: Agent) -> None:
+        """Initial heartbeat leases for a multi-replica agent (refreshed by
+        the replica monitor). Best-effort: a store blip here must not fail
+        the start — the monitor writes the same keys on its next tick."""
+        import time as _time
+
+        for eid in agent.all_engine_ids():
+            try:
+                self.store.set_json(
+                    Keys.replica_lease(agent.id, eid),
+                    {"engine_id": eid, "agent_id": agent.id, "at": _time.time()},
+                    ttl=self.lease_ttl_s,
+                )
+            except Exception:
+                self.lease_register_errors_total += 1
 
     def stop(self, agent_id: str, timeout_s: float = 10.0) -> Agent:
         with self._lock:
             agent = self.get_agent(agent_id)
             if agent.status not in (AgentStatus.RUNNING, AgentStatus.PAUSED):
                 raise InvalidTransition(agent_id, agent.status.value, "stop")
-            if agent.engine_id and self.backend.engine_info(agent.engine_id):
-                self.backend.stop_engine(agent.engine_id, timeout_s=timeout_s)
+            for eid in agent.all_engine_ids():
+                if self.backend.engine_info(eid):
+                    self.backend.stop_engine(eid, timeout_s=timeout_s)
             self._set_status(agent, AgentStatus.STOPPED)
         self._fire_quick_sync(agent_id)
         return agent
@@ -207,7 +282,8 @@ class AgentManager:
             agent = self.get_agent(agent_id)
             if agent.status != AgentStatus.RUNNING:
                 raise InvalidTransition(agent_id, agent.status.value, "pause")
-            self.backend.pause_engine(agent.engine_id)
+            for eid in agent.all_engine_ids():
+                self.backend.pause_engine(eid)
             self._set_status(agent, AgentStatus.PAUSED)
         self._fire_quick_sync(agent_id)
         return agent
@@ -219,20 +295,27 @@ class AgentManager:
         with self._lock:
             agent = self.get_agent(agent_id)
             if agent.status == AgentStatus.PAUSED:
-                self.backend.resume_engine(agent.engine_id)
+                for eid in agent.all_engine_ids():
+                    self.backend.resume_engine(eid)
             elif agent.status in (AgentStatus.STOPPED, AgentStatus.FAILED, AgentStatus.CREATED):
                 self._start_engine(agent)
             elif agent.status == AgentStatus.RUNNING:
-                info = agent.engine_id and self.backend.engine_info(agent.engine_id)
                 # probe too: a just-SIGKILL'd process reports running for a
                 # beat (exit not reapable yet) while its socket already
                 # refuses — trusting engine_info alone would no-op resume on
-                # a mid-crash agent and return success for a dead engine
-                if (
-                    not info
-                    or info.state != EngineState.RUNNING
-                    or not self.backend.probe_engine(agent.engine_id)
-                ):
+                # a mid-crash agent and return success for a dead engine.
+                # Fleet: ANY dead replica triggers repair (_start_engine
+                # reuses live replicas and recreates only the missing ones).
+                def _dead(eid: str) -> bool:
+                    info = self.backend.engine_info(eid)
+                    return (
+                        not info
+                        or info.state != EngineState.RUNNING
+                        or not self.backend.probe_engine(eid)
+                    )
+
+                ids = agent.all_engine_ids()
+                if not ids or any(_dead(eid) for eid in ids):
                     self._start_engine(agent)  # crashed-but-not-yet-reconciled
                 else:
                     return agent
@@ -244,12 +327,13 @@ class AgentManager:
         """Teardown + key cleanup including request queues (agent.go:313-370)."""
         with self._lock:
             agent = self.get_agent(agent_id)
-            if agent.engine_id and self.backend.engine_info(agent.engine_id):
-                try:
-                    self.backend.stop_engine(agent.engine_id, timeout_s=5.0)
-                except Exception:
-                    pass
-                self.backend.remove_engine(agent.engine_id)
+            for eid in agent.all_engine_ids():
+                if self.backend.engine_info(eid):
+                    try:
+                        self.backend.stop_engine(eid, timeout_s=5.0)
+                    except Exception:
+                        pass
+                    self.backend.remove_engine(eid)
             self.scheduler.release(agent_id)
             self.store.srem(Keys.AGENTS_LIST, agent_id)
             doomed = [
@@ -268,6 +352,7 @@ class AgentManager:
             doomed += self.store.keys(f"agent:{agent_id}:requests:*")
             doomed += self.store.keys(Keys.conversations_pattern(agent_id))
             doomed += self.store.keys(Keys.kvcache_pattern(agent_id))
+            doomed += self.store.keys(Keys.replica_lease_pattern(agent_id))
             self.store.delete(*doomed)
         self._fire_route_hook(None, agent_id)
 
@@ -299,6 +384,17 @@ class AgentManager:
             return None
         info = self.backend.engine_info(agent.engine_id)
         return info.endpoint if info else None
+
+    def replica_endpoints(self, agent: Agent) -> list[tuple[str, str]]:
+        """(engine_id, endpoint) for every replica whose engine record still
+        exists — the routing tier's candidate set. Order is stable (primary
+        first) so single-replica behavior degenerates to ``endpoint``."""
+        out = []
+        for eid in agent.all_engine_ids():
+            info = self.backend.engine_info(eid)
+            if info is not None and info.endpoint:
+                out.append((eid, info.endpoint))
+        return out
 
     def summary(self, agent: Agent) -> dict[str, Any]:
         placement = self.scheduler.placement(agent.id)

@@ -165,3 +165,22 @@ class LogPlane:
             and (not resource or resource in e.get("resource", ""))
         ]
         return out[-limit:]
+
+
+def warn_fallback(logs, component: str, msg: str, agent_id: str = "") -> bool:
+    """Warn through the (store-backed) log plane, falling back to stdout
+    when the plane itself is down or absent. Returns False only when the
+    plane FAILED, so callers can count log-plane outages — the fleet
+    monitor and fleet repair share this instead of each carrying its own
+    copy of the try/warn/print dance."""
+    if logs is not None:
+        try:
+            logs.warn(component, msg, agent_id=agent_id)
+            return True
+        except Exception:
+            # the log plane rides the same store that may be mid-outage:
+            # degrade to stdout, visibly, and report the failure
+            print(f"[{component}] {msg} (log plane unavailable)", flush=True)
+            return False
+    print(f"[{component}] {msg}", flush=True)
+    return True

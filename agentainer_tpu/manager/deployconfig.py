@@ -57,7 +57,12 @@ def parse_quantity(value: str | int | float) -> int:
 class AgentSpecYAML:
     name: str
     model: ModelRef
+    # fan-out count: N SEPARATE agents "name-i" (reference `replicas:`
+    # semantics, deployment.go) — distinct from engine_replicas below
     replicas: int = 1
+    # fleet engine replicas PER agent (health-aware routing, mid-decode
+    # failover); 0 = the daemon's fleet.replicas default
+    engine_replicas: int = 0
     env: dict[str, str] = field(default_factory=dict)
     resources: Resources = field(default_factory=Resources)
     auto_restart: bool = False
@@ -101,6 +106,13 @@ def parse_deployment(doc: dict[str, Any]) -> DeploymentConfig:
         replicas = int(a.get("replicas", 1))
         if replicas < 0:
             raise InvalidInput(f"agent {name!r}: replicas must be >= 0")
+        engine_replicas = int(
+            a.get("engineReplicas", a.get("engine_replicas", 0)) or 0
+        )
+        if engine_replicas < 0 or engine_replicas > 64:
+            raise InvalidInput(
+                f"agent {name!r}: engineReplicas must be 0 (fleet default) to 64"
+            )
         res_doc = a.get("resources", {}) or {}
         resources = Resources(
             chips=int(res_doc.get("chips", 1)),
@@ -120,6 +132,7 @@ def parse_deployment(doc: dict[str, Any]) -> DeploymentConfig:
                 name=name,
                 model=ModelRef.from_dict(a.get("model", a.get("image", "echo"))),
                 replicas=replicas,
+                engine_replicas=engine_replicas,
                 env={k: str(v) for k, v in (a.get("env", {}) or {}).items()},
                 resources=resources,
                 auto_restart=bool(a.get("autoRestart", a.get("auto_restart", False))),

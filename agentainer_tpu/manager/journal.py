@@ -59,6 +59,11 @@ class JournaledRequest:
     # absolute wall-clock instant after which the caller has given up; None
     # = no deadline (pre-deadline entries and deadlines=false deployments)
     deadline_at: float | None = None
+    # fleet: which engine replica the winning dispatcher forwarded to (set
+    # at acquire_processing). Fleet repair reassigns a dead replica's
+    # PROCESSING entries by this attribution instead of waiting out the
+    # replay worker's staleness window.
+    replica_id: str = ""
 
     def expired(self, now: float | None = None) -> bool:
         return self.deadline_at is not None and (now or time.time()) > self.deadline_at
@@ -83,6 +88,7 @@ class JournaledRequest:
             "created_at": self.created_at,
             "updated_at": self.updated_at,
             "deadline_at": self.deadline_at,
+            "replica_id": self.replica_id,
         }
 
     @staticmethod
@@ -104,6 +110,7 @@ class JournaledRequest:
             deadline_at=(
                 float(d["deadline_at"]) if d.get("deadline_at") is not None else None
             ),
+            replica_id=d.get("replica_id", ""),
         )
 
 
@@ -172,7 +179,9 @@ class RequestJournal:
         self.store.lrem(Keys.pending(agent_id), 1, request_id)
         self.store.rpush(Keys.completed(agent_id), request_id)
 
-    def acquire_processing(self, agent_id: str, request_id: str) -> bool:
+    def acquire_processing(
+        self, agent_id: str, request_id: str, replica_id: str = ""
+    ) -> bool:
         """Claim the pending→processing transition with a store-level
         compare-and-set; returns whether THIS caller won the claim.
 
@@ -193,11 +202,57 @@ class RequestJournal:
             if req.status != RequestStatus.PENDING:
                 return False
             req.status = RequestStatus.PROCESSING
+            req.replica_id = replica_id
             req.updated_at = time.time()
             new = json.dumps(req.to_dict(), separators=(",", ":"))
             if self.store.cas(key, raw, new):
                 return True
         return False
+
+    def set_replica(self, agent_id: str, request_id: str, replica_id: str) -> bool:
+        """Re-attribute an in-flight claim to the replica ACTUALLY serving
+        it (the proxy's cross-replica retry). Without this, an entry
+        claimed against replica A but retried onto B stays attributed to
+        A — A's later death would reassign (and re-dispatch) work B is
+        still executing, and B's death would NOT reassign work that died
+        with it. CAS-guarded and PROCESSING-only: a concurrent settle or
+        repair reassignment wins, and this becomes a no-op."""
+        key = Keys.request(agent_id, request_id)
+        for _ in range(4):
+            raw = self.store.get(key)
+            if raw is None:
+                return False
+            req = JournaledRequest.from_dict(json.loads(raw))
+            if req.status != RequestStatus.PROCESSING:
+                return False
+            if req.replica_id == replica_id:
+                return True
+            req.replica_id = replica_id
+            req.updated_at = time.time()
+            new = json.dumps(req.to_dict(), separators=(",", ":"))
+            if self.store.cas(key, raw, new):
+                return True
+        return False
+
+    def reassign_replica(self, agent_id: str, engine_id: str) -> int:
+        """Fleet repair: a replica died — every PROCESSING entry attributed
+        to it goes back to PENDING immediately (the winning dispatcher's
+        forward can never settle; its HTTP call got connection-reset). The
+        replay worker's staleness reclaim remains the safety net for
+        entries with no/stale attribution. Returns how many were reassigned.
+        Idempotent and double-execution-safe: re-dispatch re-enters the
+        acquire_processing CAS, and the engine memoizes by request id."""
+        n = 0
+        for rid in self.pending_ids(agent_id):
+            req = self.get(agent_id, rid)
+            if (
+                req is not None
+                and req.status == RequestStatus.PROCESSING
+                and req.replica_id == engine_id
+            ):
+                self.mark_pending(agent_id, rid)
+                n += 1
+        return n
 
     def mark_processing(self, agent_id: str, request_id: str) -> None:
         """Best-effort processing flag for forced re-dispatch paths (manual

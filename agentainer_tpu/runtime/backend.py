@@ -57,8 +57,15 @@ class Backend(ABC):
     """Lifecycle operations over engine processes."""
 
     @abstractmethod
-    def create_engine(self, agent: Agent, chips: tuple[int, ...]) -> str:
+    def create_engine(
+        self, agent: Agent, chips: tuple[int, ...], replica_index: int = 0
+    ) -> str:
         """Create (but do not start) an engine; returns engine_id.
+
+        ``replica_index`` distinguishes fleet replicas of the same agent:
+        each replica must be its OWN failure domain (own process), so
+        backends that pool same-model engines must key the pool per
+        replica, never collapse two replicas into one process.
 
         Parity: container creation with labels/hostname/limits but no start
         (reference agent.go:431-508 createContainer).
@@ -138,14 +145,18 @@ class FakeBackend(Backend):
             except Exception:
                 pass
 
-    def create_engine(self, agent: Agent, chips: tuple[int, ...]) -> str:
+    def create_engine(
+        self, agent: Agent, chips: tuple[int, ...], replica_index: int = 0
+    ) -> str:
         with self._lock:
             engine_id = f"eng-{uuid.uuid4().hex[:12]}"
             self._engines[engine_id] = EngineInfo(
                 engine_id=engine_id,
                 agent_id=agent.id,
                 state=EngineState.CREATED,
-                endpoint=f"fake://{agent.id}",
+                # the engine id rides the endpoint so the proxy's fake://
+                # dispatch reaches the ROUTED replica, not always the primary
+                endpoint=f"fake://{agent.id}/{engine_id}",
                 chips=chips,
             )
             self._logs[engine_id] = [f"created engine for {agent.id} on chips {chips}"]

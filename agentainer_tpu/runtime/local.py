@@ -185,17 +185,28 @@ class LocalBackend(Backend):
         self.store_sock = uds_path
 
     # -- backend interface ----------------------------------------------
-    def create_engine(self, agent: Agent, chips: tuple[int, ...]) -> str:
+    def create_engine(
+        self, agent: Agent, chips: tuple[int, ...], replica_index: int = 0
+    ) -> str:
         engine_id = f"eng-{uuid.uuid4().hex[:12]}"
         port = _free_port()
-        # Per-engine store credential: engines never see the admin token, and
+        # Per-agent store credential: engines never see the admin token, and
         # the control plane validates this one against internal:token:{id}
-        # (outside the namespace engines can reach).
+        # (outside the namespace engines can reach). The token is an
+        # AGENT-scoped capability, so fleet replicas REUSE an existing one —
+        # a second replica minting its own would overwrite the key and 401
+        # the first replica's snapshot/conversation writes mid-flight.
         engine_token = uuid.uuid4().hex + uuid.uuid4().hex
         if self.store is not None:
             from ..store.schema import Keys
 
-            self.store.set(Keys.internal_token(agent.id), engine_token)
+            existing = self.store.get(Keys.internal_token(agent.id))
+            if existing:
+                engine_token = (
+                    existing.decode() if isinstance(existing, bytes) else str(existing)
+                )
+            else:
+                self.store.set(Keys.internal_token(agent.id), engine_token)
         env = dict(os.environ)
         env.update(agent.env)
         env.update(
@@ -209,6 +220,9 @@ class LocalBackend(Backend):
                 # same env channel the reference uses for container config
                 "AGENTAINER_MODEL_OPTIONS": json.dumps(agent.model.options or {}),
                 "AGENTAINER_PORT": str(port),
+                # fleet replica ordinal: engines surface it in /metrics so
+                # operators can attribute traffic/restarts to one replica
+                "AGENTAINER_REPLICA": str(replica_index),
                 "AGENTAINER_CHIPS": ",".join(map(str, chips)),
                 "AGENTAINER_CONTROL_URL": self.control_url,
                 "AGENTAINER_INTERNAL_TOKEN": engine_token,
@@ -248,11 +262,17 @@ class LocalBackend(Backend):
             opts = dict(agent.model.options or {})
             for k in _PERSONA_OPTS:
                 opts.pop(k, None)
+            # replica_index is part of the share key: a fleet replica must
+            # be its OWN failure domain. Two AGENTS sharing a model still
+            # share one host per replica ordinal, but two REPLICAS of one
+            # agent never collapse into the same process — killing one
+            # must leave the other serving.
             rec.share_key = (
                 agent.model.config,
                 agent.model.checkpoint,
                 json.dumps(opts, sort_keys=True),
                 chips,
+                replica_index,
             )
             rec.log_path = self._dir / "engines" / f"host-{self._host_slug(rec.share_key)}.log"
         with self._lock:

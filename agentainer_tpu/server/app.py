@@ -34,10 +34,11 @@ from aiohttp import ClientSession, ClientTimeout, web
 
 from .. import faults
 from ..core.errors import AgentainerError, AgentNotFound
-from ..core.resilience import CircuitBreaker
+from ..core.resilience import CircuitBreaker, retry_after_jitter
 from ..core.spec import AgentStatus, HealthCheckConfig, ModelRef, Resources
 from ..manager.journal import RequestStatus
 from ..store.schema import Keys
+from .router import ReplicaChoice, ReplicaRouter
 
 if TYPE_CHECKING:
     from ..daemon import Services
@@ -155,6 +156,23 @@ class ControlPlaneApp:
             failure_threshold=getattr(res, "breaker_failures", 5),
             cooldown_s=getattr(res, "breaker_cooldown_s", 2.0),
         )
+        # fleet routing tier: engages only for agents with >1 replica; the
+        # single-replica dispatch path is byte-identical to pre-fleet.
+        # ATPU_JITTER_SEED pins BOTH the p2c sample sequence and the
+        # Retry-After jitter (chaos/bench determinism); unset = entropy.
+        import random as _random
+
+        fleet_cfg = getattr(services.config, "fleet", None)
+        seed_raw = os.environ.get("ATPU_JITTER_SEED", "")
+        self.router = ReplicaRouter(
+            services.manager,
+            fleet_cfg,
+            seed=int(seed_raw) if seed_raw else _random.randrange(1 << 30),
+        )
+        # seeded Retry-After jitter: synchronized clients shed in the same
+        # instant must not retry in the same instant (re-stampeding exactly
+        # the replica that was recovering)
+        self._retry_rng = _random.Random(int(seed_raw)) if seed_raw else _random.Random()
         self.journal_errors_total = 0
         self.journal_skipped_total = 0
         self.abort_cancel_errors_total = 0
@@ -300,6 +318,10 @@ class ControlPlaneApp:
                 model.pop("artifact", None)
                 model["checkpoint"] = doc["path"]
                 model.setdefault("engine", "llm")
+        try:
+            replicas = int(body.get("replicas", 0) or 0)
+        except (TypeError, ValueError):
+            return fail("replicas must be an integer", status=400)
         agent = await self._mgr(
             self.s.manager.deploy,
             name=body.get("name", ""),
@@ -309,6 +331,7 @@ class ControlPlaneApp:
             auto_restart=bool(body.get("auto_restart", False)),
             token=body.get("token", ""),
             health_check=HealthCheckConfig.from_dict(body.get("health_check")),
+            replicas=replicas,
         )
         self._audit(request, "deploy", agent.id, "success")
         return ok(self.s.manager.summary(agent), message="Agent deployed successfully")
@@ -508,10 +531,22 @@ class ControlPlaneApp:
         self.s.manager.get_agent(agent_id)
         return ok(self.s.health.get_status(agent_id))
 
+    def _fleet_stats(self, agent) -> dict | None:
+        """Routing/per-replica breaker view for a multi-replica agent; None
+        for single-replica agents (their metrics doc stays pre-fleet)."""
+        if len(agent.all_engine_ids()) <= 1:
+            return None
+        return self.router.stats(agent)
+
     async def h_agent_metrics(self, request: web.Request) -> web.Response:
         agent_id = request.match_info["agent_id"]
-        self.s.manager.get_agent(agent_id)
-        return ok(self.s.metrics.current(agent_id))
+        agent = self.s.manager.get_agent(agent_id)
+        doc = self.s.metrics.current(agent_id) or {}
+        fleet = self._fleet_stats(agent)
+        if fleet is not None:
+            doc = dict(doc)
+            doc["fleet"] = fleet
+        return ok(doc)
 
     async def h_agent_metrics_history(self, request: web.Request) -> web.Response:
         agent_id = request.match_info["agent_id"]
@@ -523,7 +558,13 @@ class ControlPlaneApp:
     async def h_all_metrics(self, request: web.Request) -> web.Response:
         out = {}
         for agent_id in self.s.manager.agent_ids():
-            out[agent_id] = self.s.metrics.current(agent_id)
+            doc = self.s.metrics.current(agent_id)
+            agent = self.s.manager.try_get(agent_id)
+            fleet = self._fleet_stats(agent) if agent is not None else None
+            if fleet is not None:
+                doc = dict(doc or {})
+                doc["fleet"] = fleet
+            out[agent_id] = doc
         return ok(out)
 
     async def h_get_logs(self, request: web.Request) -> web.StreamResponse:
@@ -952,7 +993,11 @@ class ControlPlaneApp:
                     return fail(
                         f"overloaded: {reason}; retry later",
                         status=429,
-                        headers={"Retry-After": str(max(1, int(round(dl.retry_after_s))))},
+                        headers={
+                            "Retry-After": str(
+                                retry_after_jitter(dl.retry_after_s, self._retry_rng)
+                            )
+                        },
                     )
             # Journal behind the store circuit breaker: with the store dark
             # the proxy must not stack a timeout per request. Degradation
@@ -1004,7 +1049,9 @@ class ControlPlaneApp:
                     status=503,
                     headers={
                         "Retry-After": str(
-                            max(1, int(round(self._store_breaker.cooldown_s)))
+                            retry_after_jitter(
+                                self._store_breaker.cooldown_s, self._retry_rng
+                            )
                         )
                     },
                 )
@@ -1037,16 +1084,34 @@ class ControlPlaneApp:
                     # nobody reads this; it closes the handler cleanly
                     return web.Response(status=499, reason="Client Closed Request")
         status, resp_headers, resp_body = await dispatch
+        # error envelopes for JOURNALED dispatches carry the request id too:
+        # a 502/504 is not the end of the story — the entry stays in the
+        # journal (pending replay, or retry-accounted), and the id lets the
+        # caller poll /agents/{id}/requests/{rid} for the eventual outcome
+        # (a mid-decode replica death settles the SAME id on a survivor)
+        rid_headers = {REQUEST_ID_HEADER: request_id} if request_id else None
         if status == DISPATCH_ENGINE_GONE:
             # connection-level failure: the crash heuristic leaves the request
             # pending for the replay worker (server.go:597-606)
-            return fail("agent unreachable; request left pending for replay", status=502)
+            return fail(
+                "agent unreachable; request left pending for replay",
+                status=502,
+                headers=rid_headers,
+            )
         if status == DISPATCH_FAILED:
             # non-crash failure (timeout, protocol error): retry accounting
             # ran; the entry dead-letters after MAX_RETRIES
-            return fail("agent request failed; retry recorded", status=504)
+            return fail(
+                "agent request failed; retry recorded",
+                status=504,
+                headers=rid_headers,
+            )
         if status == DISPATCH_EXPIRED:
-            return fail("deadline exceeded; request dead-lettered", status=504)
+            return fail(
+                "deadline exceeded; request dead-lettered",
+                status=504,
+                headers=rid_headers,
+            )
         if status == DISPATCH_IN_FLIGHT:
             # an in-process replay tick CAS-claimed the freshly journaled
             # entry first (it scans whenever the agent has anything
@@ -1174,6 +1239,7 @@ class ControlPlaneApp:
         request_id: str = "",
         deadline_at: float | None = None,
         force: bool = False,
+        session_hint: str = "",
     ) -> tuple[int, dict[str, str], bytes]:
         """Forward to the engine and settle the journal entry.
 
@@ -1193,10 +1259,37 @@ class ControlPlaneApp:
         - timeout / protocol error → retry-count++ via mark_failed (dead-
           letters after MAX_RETRIES); returns DISPATCH_FAILED. The reference
           misclassifies slow responses as crashes, replaying them forever.
+
+        Fleet (agent has >1 replica): the routing tier picks the replica
+        (session affinity → health exclusion → power-of-two-choices), and
+        a connection-level failure retries on the NEXT replica, bounded by
+        ``fleet.retry_next_replica``. The retry re-forwards the SAME claim:
+        nothing executed on the dead replica (connection refused/reset
+        before a response), the CAS admitted exactly this dispatcher, and
+        the engine memoizes by request id — so cross-replica retry cannot
+        double-execute. Single-replica agents never enter the router.
         """
         agent = self.s.manager.get_agent(agent_id)
-        endpoint = self.s.manager.endpoint(agent)
-        if endpoint is None:
+        multi = len(agent.all_engine_ids()) > 1
+        if multi:
+            if not session_hint:
+                # session-affinity hint: chat-style bodies name their
+                # session. Parsed HERE (not in h_proxy) so every dispatcher
+                # — live proxy, replay worker re-dispatch, manual replay —
+                # pins the session to the replica that actually serves it;
+                # a failed-over session's next turn then follows the
+                # survivor instead of racing the dead replica's respawn.
+                # Single-replica agents never pay this parse.
+                session_hint = self._session_hint(body)
+            choice = self.router.pick(agent, session=session_hint)
+        else:
+            endpoint = self.s.manager.endpoint(agent)
+            choice = (
+                None
+                if endpoint is None
+                else ReplicaChoice(agent.engine_id, endpoint)
+            )
+        if choice is None:
             return DISPATCH_ENGINE_GONE, {}, b""
         if deadline_at is not None and time.time() > deadline_at:
             if request_id:
@@ -1212,7 +1305,9 @@ class ControlPlaneApp:
                 self._journal_op(self.s.journal.mark_processing, agent_id, request_id)
             else:
                 try:
-                    claimed = self.s.journal.acquire_processing(agent_id, request_id)
+                    claimed = self.s.journal.acquire_processing(
+                        agent_id, request_id, replica_id=choice.engine_id
+                    )
                 except Exception:
                     # can't verify the claim with the store dark — another
                     # dispatcher may own the entry, so do NOT forward: the
@@ -1224,34 +1319,136 @@ class ControlPlaneApp:
                 if not claimed:
                     return DISPATCH_IN_FLIGHT, {}, b""
 
-        if endpoint.startswith("fake://"):
-            # in-process dispatch for the unit-test backend
-            handler = getattr(self.s.backend, "handle_request", None)
-            if handler is None:
-                if request_id:
-                    self._journal_op(self.s.journal.mark_pending, agent_id, request_id)
-                return DISPATCH_ENGINE_GONE, {}, b""
-            try:
-                faults.fire("proxy.dispatch")
-                status, resp_headers, resp_body = handler(
-                    agent.engine_id, method, path, headers, body
+        tried: set[str] = set()
+        attempts = 0
+        # bound by ATTEMPTS, not distinct replicas: a stale routing table
+        # (router.pick failpoint) can hand the same dead replica back
+        # twice, and that must consume the retry budget, not loop forever
+        max_attempts = 1 + (self.router.retry_next_replica if multi else 0)
+        while True:
+            attempts += 1
+            result = await self._dispatch_once(
+                agent, choice, multi, method, path, headers, body,
+                request_id, deadline_at,
+            )
+            if result is not None:
+                return result
+            # connection-level failure (or loading/draining): nothing ran
+            # on that replica — eligible for the bounded next-replica retry
+            tried.add(choice.engine_id)
+            choice = None
+            if multi and attempts < max_attempts:
+                choice = self.router.pick(
+                    agent, session=session_hint, exclude=frozenset(tried)
                 )
-            except ConnectionError:
+            if choice is None:
+                # every (allowed) replica refused at the connection level:
+                # the crash heuristic leaves the request pending for the
+                # replay worker (server.go:597-606)
                 if request_id:
-                    self._journal_op(self.s.journal.mark_pending, agent_id, request_id)
+                    self._journal_op(
+                        self.s.journal.mark_pending, agent_id, request_id
+                    )
                 return DISPATCH_ENGINE_GONE, {}, b""
-            if request_id:
+            if request_id and not force:
+                # re-attribute the claim to the replica this retry actually
+                # forwards to: fleet repair reassigns by attribution, and a
+                # stale one would reset work the NEW replica is executing
+                # (or fail to reset work that died with it)
                 self._journal_op(
-                    self.s.journal.store_response,
+                    self.s.journal.set_replica,
                     agent_id,
                     request_id,
-                    status,
-                    resp_headers,
-                    resp_body,
+                    choice.engine_id,
                 )
-            self.s.metrics.count_request(agent_id)
-            return status, resp_headers, resp_body
 
+    @staticmethod
+    def _session_hint(body: bytes) -> str:
+        if not body:
+            return ""
+        try:
+            doc = json.loads(body)
+            return str(doc.get("session", "") or "") if isinstance(doc, dict) else ""
+        except (ValueError, UnicodeDecodeError):
+            return ""
+
+    async def _dispatch_once(
+        self,
+        agent,
+        choice: ReplicaChoice,
+        multi: bool,
+        method: str,
+        path: str,
+        headers: dict[str, str],
+        body: bytes,
+        request_id: str,
+        deadline_at: float | None,
+    ) -> tuple[int, dict[str, str], bytes] | None:
+        """One forwarding attempt against one replica. Returns the settled
+        outcome tuple, or None for a connection-level failure / not-admitting
+        503 (loading or draining) — the retryable class where nothing
+        executed, with NO journal settle (the caller owns pending-vs-retry).
+        Every other outcome settles the journal exactly as pre-fleet."""
+        agent_id = agent.id
+        endpoint = choice.endpoint
+        if multi:
+            self.router.begin(choice.engine_id)
+        replica_ok = False
+        try:
+            if endpoint.startswith("fake://"):
+                # in-process dispatch for the unit-test backend; the routed
+                # engine id (not always the primary) receives the request
+                handler = getattr(self.s.backend, "handle_request", None)
+                if handler is None:
+                    return None
+                try:
+                    faults.fire("proxy.dispatch")
+                    status, resp_headers, resp_body = handler(
+                        choice.engine_id or agent.engine_id,
+                        method,
+                        path,
+                        headers,
+                        body,
+                    )
+                except ConnectionError:
+                    return None
+                replica_ok = True
+                if request_id:
+                    self._journal_op(
+                        self.s.journal.store_response,
+                        agent_id,
+                        request_id,
+                        status,
+                        resp_headers,
+                        resp_body,
+                    )
+                self.s.metrics.count_request(agent_id)
+                return status, resp_headers, resp_body
+            result, replica_ok = await self._dispatch_http(
+                agent_id, endpoint, method, path, headers, body,
+                request_id, deadline_at,
+            )
+            return result
+        finally:
+            if multi:
+                # per-replica breaker feed: anything that answered over the
+                # socket is proof of life; connection-level failures and
+                # timeouts count against THIS replica's breaker only
+                self.router.end(choice.engine_id, replica_ok)
+
+    async def _dispatch_http(
+        self,
+        agent_id: str,
+        endpoint: str,
+        method: str,
+        path: str,
+        headers: dict[str, str],
+        body: bytes,
+        request_id: str,
+        deadline_at: float | None,
+    ) -> tuple[tuple[int, dict[str, str], bytes] | None, bool]:
+        """HTTP forwarding leg of ``_dispatch_once``; returns
+        (outcome | None, replica_answered)."""
         url = endpoint.rstrip("/") + path
         fwd_headers = dict(headers)
         fwd_headers.pop("Authorization", None)
@@ -1288,10 +1485,11 @@ class ControlPlaneApp:
             ) as resp:
                 resp_body = await resp.read()
                 resp_headers = dict(resp.headers)
-        except (aiohttp.ClientConnectorError, ConnectionError) as e:
-            if request_id:
-                self._journal_op(self.s.journal.mark_pending, agent_id, request_id)
-            return DISPATCH_ENGINE_GONE, {}, b""
+        except (aiohttp.ClientConnectorError, ConnectionError):
+            # connection-level failure: retryable on another replica (the
+            # caller owns the pending-vs-next-replica decision — nothing
+            # executed here, so nothing is settled here)
+            return None, False
         except (asyncio.TimeoutError, aiohttp.ClientError, OSError) as e:
             if deadline_at is not None and time.time() > deadline_at:
                 # the wait ran out the caller's budget: dead-letter and tell
@@ -1304,7 +1502,7 @@ class ControlPlaneApp:
                         reason="deadline exceeded",
                     )
                     await self._cancel_on_engine(endpoint, request_id)
-                return DISPATCH_EXPIRED, {}, b""
+                return (DISPATCH_EXPIRED, {}, b""), False
             if request_id:
                 self._journal_op(
                     self.s.journal.mark_failed,
@@ -1312,18 +1510,16 @@ class ControlPlaneApp:
                     request_id,
                     f"{type(e).__name__}: {e}",
                 )
-            return DISPATCH_FAILED, {}, b""
+            return (DISPATCH_FAILED, {}, b""), False
         if resp.status == 503 and (
             resp_headers.get(LOADING_HEADER, "").lower() == "true"
             or resp_headers.get(DRAINING_HEADER, "").lower() == "true"
         ):
             # engine process is up but not admitting (model still loading,
-            # or SIGTERM drain in progress): same journal treatment as
-            # engine-gone — stays pending, no retry charged, the replay
-            # worker re-dispatches once it is back
-            if request_id:
-                self._journal_op(self.s.journal.mark_pending, agent_id, request_id)
-            return DISPATCH_ENGINE_GONE, {}, b""
+            # or SIGTERM drain in progress): retryable like engine-gone —
+            # single replica: stays pending for the replay worker; fleet:
+            # another replica takes the dispatch right now
+            return None, True
         if resp_headers.get(EXPIRED_HEADER, "").lower() == "true":
             # the engine dropped it by deadline policy: dead-letter, don't
             # archive a 504 as a completed response
@@ -1334,7 +1530,7 @@ class ControlPlaneApp:
                     request_id,
                     reason="expired on engine",
                 )
-            return DISPATCH_EXPIRED, {}, b""
+            return (DISPATCH_EXPIRED, {}, b""), True
         if resp.status == 429:
             # engine-side shed: overload is transient — the entry goes back
             # to pending for a later replay tick (no retry charged; losing
@@ -1343,7 +1539,7 @@ class ControlPlaneApp:
             # Retry-After to back off on its own
             if request_id:
                 self._journal_op(self.s.journal.mark_pending, agent_id, request_id)
-            return resp.status, resp_headers, resp_body
+            return (resp.status, resp_headers, resp_body), True
         if request_id:
             self._journal_op(
                 self.s.journal.store_response,
@@ -1354,7 +1550,7 @@ class ControlPlaneApp:
                 resp_body,
             )
         self.s.metrics.count_request(agent_id, latency_s=time.monotonic() - t0)
-        return resp.status, resp_headers, resp_body
+        return (resp.status, resp_headers, resp_body), True
 
     async def _await_archived(
         self, agent_id: str, request_id: str, deadline_at: float | None
@@ -1381,7 +1577,9 @@ class ControlPlaneApp:
                     status=503,
                     headers={
                         "Retry-After": str(
-                            max(1, int(round(self._store_breaker.cooldown_s)))
+                            retry_after_jitter(
+                                self._store_breaker.cooldown_s, self._retry_rng
+                            )
                         )
                     },
                 )

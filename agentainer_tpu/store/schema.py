@@ -114,6 +114,18 @@ class Keys:
         return f"agent:{agent_id}:metrics"
 
     @staticmethod
+    def replica_lease(agent_id: str, engine_id: str) -> str:
+        """Heartbeat lease for one engine replica: a JSON doc written with
+        a TTL by the replica monitor. Lease age drives the per-replica
+        ALIVE/SUSPECT/DEAD state machine; an expired (absent) lease is the
+        durable evidence a replica stopped answering."""
+        return f"agent:{agent_id}:replica:{engine_id}:lease"
+
+    @staticmethod
+    def replica_lease_pattern(agent_id: str) -> str:
+        return f"agent:{agent_id}:replica:*:lease"
+
+    @staticmethod
     def kvcache(agent_id: str, session_id: str) -> str:
         return f"agent:{agent_id}:kvcache:{session_id}"
 

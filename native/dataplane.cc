@@ -712,6 +712,16 @@ void DataPlane::handle_conn(int fd) {
         continue;
       }
 
+      if (route.port == 0 && route.status == "running") {
+        // python-owned route (replica fleet): a RUNNING agent with no
+        // single endpoint means the aiohttp proxy owns its dispatch —
+        // replica choice, session affinity, bounded cross-replica retry,
+        // AND the journaling. Fall through to the management forward
+        // below with the request untouched instead of dispatching
+        // natively to one endpoint (which is exactly the primary-only
+        // blind spot the routing tier exists to fix).
+      } else {
+
       // journal entry (before dispatch — the signature guarantee)
       JEntry e;
       e.agent_id = agent_id;
@@ -837,6 +847,7 @@ void DataPlane::handle_conn(int fd) {
       }
       if (!send_all(fd, resp_raw) || !keep) break;
       continue;
+      }  // end native-dispatch branch (python-owned routes fall through)
     }
 
     // ---- management path: forward verbatim to the Python server ----------

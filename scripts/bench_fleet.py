@@ -1,0 +1,380 @@
+"""Fleet bench: goodput + p99 TTFT vs replica count, failover MTTR, and
+resumed-stream token identity — BENCH_fleet.json.
+
+Three tiers against a live daemon (TestClient; same harness as the chaos
+soak):
+
+1. **scaling sweep** — a tiny-LLM agent at replicas 1 / 2 / 4 takes a
+   closed-loop concurrent burst of short chat turns; goodput (200s/s)
+   and p50/p99 request latency (the TTFT proxy for a non-streaming
+   engine) are recorded per replica count. The LLM engine is the honest
+   scaling subject: decode is compute-bound, so independent replica
+   PROCESSES parallelize across host cores, whereas the echo engine is
+   proxy-bound and would only measure routing overhead. replicas=1 is
+   the A/B baseline: it routes through the exact pre-fleet
+   single-endpoint path (the router never engages).
+2. **failover MTTR** — a 2-replica echo fleet under steady probes has one
+   replica SIGKILLed; MTTR is the longest service gap observed at the
+   caller (the fleet answer: a survivor serves while repair respawns).
+3. **resumed-stream token identity** — a 2-replica tiny-LLM fleet runs
+   the chaos soak's control/victim pair: the victim's replica dies
+   MID-DECODE and the journaled turn must settle token-identical to the
+   control on the surviving replica.
+
+ATPU_FLEET_SMOKE=1 shortens the burst volumes (make fleet). Seeded:
+traffic, routing p2c, and Retry-After jitter all derive from
+ATPU_FLEET_SEED (default 1337).
+
+Usage: JAX_PLATFORMS=cpu python scripts/bench_fleet.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from _benchlib import percentile, write_artifact  # noqa: E402
+
+from agentainer_tpu.config import Config  # noqa: E402
+from agentainer_tpu.daemon import (  # noqa: E402
+    build_services,
+    start_background,
+    stop_background,
+)
+from agentainer_tpu.runtime.local import LocalBackend  # noqa: E402
+from agentainer_tpu.store import MemoryStore  # noqa: E402
+
+SEED = int(os.environ.get("ATPU_FLEET_SEED", "1337"))
+SMOKE = os.environ.get("ATPU_FLEET_SMOKE", "") not in ("", "0", "false")
+TOKEN = "fleet-token"
+AUTH = {"Authorization": f"Bearer {TOKEN}"}
+
+
+class Stack:
+    def __init__(self, tmpdir: str):
+        self.tmpdir = tmpdir
+        self.services = None
+        self.client = None
+
+    async def start(self) -> None:
+        from aiohttp.test_utils import TestClient, TestServer
+
+        os.environ["ATPU_JITTER_SEED"] = str(SEED)
+        cfg = Config()
+        cfg.auth_token = TOKEN
+        cfg.cadences.replay_scan_s = 1.0
+        cfg.cadences.state_sync_s = 2.0
+        cfg.fleet.lease_interval_s = 0.25
+        cfg.fleet.suspect_after_s = 1.0
+        cfg.fleet.dead_after_s = 2.0
+        backend = LocalBackend(data_dir=self.tmpdir, ready_timeout_s=90.0)
+        self.services = build_services(
+            config=cfg,
+            store=MemoryStore(),
+            backend=backend,
+            console_logs=False,
+            data_dir=self.tmpdir,
+        )
+        self.client = TestClient(TestServer(self.services.app))
+        await self.client.start_server()
+        backend.set_control(f"http://127.0.0.1:{self.client.server.port}", TOKEN)
+        await start_background(self.services)
+
+    async def stop(self) -> None:
+        if self.services is not None:
+            await stop_background(self.services)
+            self.services.backend.close()
+        if self.client is not None:
+            await self.client.close()
+
+    async def deploy(self, name: str, model, replicas: int, **kw) -> str:
+        resp = await self.client.post(
+            "/agents",
+            json={"name": name, "model": model, "replicas": replicas, **kw},
+            headers=AUTH,
+        )
+        doc = await resp.json()
+        assert resp.status == 200, doc
+        agent_id = doc["data"]["id"]
+        resp = await self.client.post(f"/agents/{agent_id}/start", headers=AUTH)
+        assert resp.status == 200, await resp.text()
+        return agent_id
+
+    async def remove(self, agent_id: str) -> None:
+        await self.client.delete(f"/agents/{agent_id}", headers=AUTH)
+
+
+async def closed_loop_burst(
+    stack: Stack, agent_id: str, total: int, concurrency: int
+) -> dict:
+    """``total`` chats at fixed concurrency; per-request latency + goodput."""
+    lat: list[float] = []
+    errors = 0
+    seq = 0
+    lock = asyncio.Lock()
+
+    async def worker():
+        nonlocal seq, errors
+        while True:
+            async with lock:
+                if seq >= total:
+                    return
+                seq += 1
+                n = seq
+            t0 = time.monotonic()
+            resp = await stack.client.post(
+                f"/agent/{agent_id}/chat",
+                data=json.dumps(
+                    {
+                        "message": f"fleet-{SEED}-{n}",
+                        "session": f"s{n % 16}",
+                        "max_tokens": 8,
+                        "ignore_eos": True,
+                    }
+                ),
+            )
+            await resp.read()
+            if resp.status == 200:
+                lat.append(time.monotonic() - t0)
+            else:
+                errors += 1
+
+    t0 = time.monotonic()
+    await asyncio.gather(*(worker() for _ in range(concurrency)))
+    wall = time.monotonic() - t0
+    lat.sort()
+    return {
+        "requests": total,
+        "ok": len(lat),
+        "errors": errors,
+        "wall_s": round(wall, 3),
+        "goodput_rps": round(len(lat) / wall, 2) if wall > 0 else 0.0,
+        "ttft_p50_ms": round(1000 * percentile(lat, 0.50), 2) if lat else None,
+        "ttft_p99_ms": round(1000 * percentile(lat, 0.99), 2) if lat else None,
+    }
+
+
+LLM_MODEL = {
+    "engine": "llm",
+    "config": "tiny",
+    "options": {"max_batch": 4, "max_seq": 256, "prefill_chunk": 64},
+}
+
+
+async def _wait_loaded(stack: Stack, agent_id: str, cap_s: float = 120.0) -> None:
+    rec = stack.services.manager.get_agent(agent_id)
+    t0 = time.monotonic()
+    for eid in rec.all_engine_ids():
+        while time.monotonic() - t0 < cap_s:
+            if (stack.services.backend.stats(eid) or {}).get("model_loaded"):
+                break
+            await asyncio.sleep(0.5)
+
+
+async def tier_scaling(stack: Stack) -> dict:
+    total = 24 if SMOKE else 120
+    concurrency = 8
+    out = {}
+    for n in (1, 2, 4):
+        agent_id = await stack.deploy(f"fleet-llm-{n}", LLM_MODEL, replicas=n)
+        await _wait_loaded(stack, agent_id)
+        # tiny warm pass so residual load/attach cost stays out of the sweep
+        await closed_loop_burst(stack, agent_id, 4, 2)
+        out[str(n)] = await closed_loop_burst(stack, agent_id, total, concurrency)
+        await stack.remove(agent_id)
+    return out
+
+
+async def tier_failover_mttr(stack: Stack) -> dict:
+    """Steady 50 Hz probes against a 2-replica fleet; SIGKILL one replica;
+    MTTR = the longest observed gap between consecutive 200s around the
+    kill (the caller-visible outage, not the process respawn time)."""
+    agent_id = await stack.deploy("fleet-mttr", "echo", replicas=2, auto_restart=True)
+    gaps: list[float] = []
+    last_ok = time.monotonic()
+    killed_at = None
+    victim = stack.services.manager.get_agent(agent_id).all_engine_ids()[0]
+    t_end = time.monotonic() + (6.0 if SMOKE else 12.0)
+    while time.monotonic() < t_end:
+        if killed_at is None and time.monotonic() > t_end - (5.0 if SMOKE else 9.0):
+            killed_at = time.monotonic()
+            stack.services.backend.kill_engine_hard(victim)
+        resp = await stack.client.post(
+            f"/agent/{agent_id}/chat", data=json.dumps({"message": "probe"})
+        )
+        await resp.read()
+        now = time.monotonic()
+        if resp.status == 200:
+            gaps.append(now - last_ok)
+            last_ok = now
+        await asyncio.sleep(0.02)
+    await stack.remove(agent_id)
+    return {
+        "killed": killed_at is not None,
+        "probes_ok": len(gaps),
+        "mttr_s": round(max(gaps), 3) if gaps else None,
+    }
+
+
+async def tier_token_identity(stack: Stack) -> dict:
+    """Chaos-soak failover compressed: ctl turn1/2 clean; vic turn1, then
+    its replica dies mid-decode of turn2; the settled turn2 must equal the
+    control's bit for bit."""
+    agent_id = await stack.deploy(
+        "fleet-llm",
+        {
+            "engine": "llm",
+            "config": "tiny",
+            # plain decode: the kill must land mid-decode, not after a
+            # spec-accelerated turn already finished (see chaos_soak.py)
+            "options": {
+                "max_batch": 2,
+                "max_seq": 256,
+                "prefill_chunk": 64,
+                "kv_snapshot_interval_s": 0.5,
+                "speculative": False,
+            },
+        },
+        replicas=2,
+        auto_restart=True,
+        # same deterministic mid-decode window as the chaos soak: a
+        # delay-only decode failpoint (symmetric, token-stream-neutral)
+        env={"ATPU_FAULTS": "engine.decode_step:error=none,delay_ms=150"},
+    )
+    # both replicas must finish model load before the control turns
+    rec = stack.services.manager.get_agent(agent_id)
+    t_warm = time.monotonic()
+    for eid in rec.all_engine_ids():
+        while time.monotonic() - t_warm < 90.0:
+            if (stack.services.backend.stats(eid) or {}).get("model_loaded"):
+                break
+            await asyncio.sleep(0.5)
+
+    async def turn(session, message, n=12):
+        resp = await stack.client.post(
+            f"/agent/{agent_id}/chat",
+            data=json.dumps(
+                {"message": message, "session": session, "max_tokens": n, "ignore_eos": True}
+            ),
+        )
+        doc = await resp.json()
+        return resp.status, doc.get("response", ""), resp.headers.get(
+            "X-Agentainer-Request-ID", ""
+        )
+
+    s, _, _ = await turn("ctl", "alpha alpha alpha")
+    assert s == 200
+    s, ctl_t2, _ = await turn("ctl", "beta beta", n=32)
+    assert s == 200
+    s, _, _ = await turn("vic", "alpha alpha alpha")
+    assert s == 200
+    kv_key = f"agent:{agent_id}:kvcache:vic"
+    t0 = time.monotonic()
+    while stack.services.store.get(kv_key) is None:
+        if time.monotonic() - t0 > 45:
+            return {"token_identical": False, "reason": "snapshot never landed"}
+        await asyncio.sleep(0.25)
+    router = stack.services.router
+    with router._lock:
+        victim = router._affinity.get((agent_id, "vic"), "")
+    if not victim:
+        return {"token_identical": False, "reason": "no affinity"}
+    task = asyncio.ensure_future(turn("vic", "beta beta", n=32))
+    await asyncio.sleep(0.25)
+    t_kill = time.monotonic()
+    stack.services.backend.kill_engine_hard(victim)
+    status, live, rid = await task
+    resumed = None
+    if status == 200:
+        resumed = live
+    elif rid:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            req = stack.services.journal.get(agent_id, rid)
+            if req is not None and req.status == "completed":
+                import base64 as _b64
+
+                body = _b64.b64decode((req.response or {}).get("body_b64", "") or "")
+                try:
+                    resumed = json.loads(body).get("response", "")
+                except Exception:
+                    resumed = ""
+                break
+            await asyncio.sleep(0.25)
+    out = {
+        "token_identical": resumed == ctl_t2,
+        "mid_decode_status": status,
+        "failover_settle_s": round(time.monotonic() - t_kill, 3),
+    }
+    await stack.remove(agent_id)
+    return out
+
+
+async def run_bench(tmpdir: str) -> dict:
+    stack = Stack(tmpdir)
+    try:
+        await stack.start()
+        scaling = await tier_scaling(stack)
+        mttr = await tier_failover_mttr(stack)
+        identity = await tier_token_identity(stack)
+    finally:
+        await stack.stop()
+    return {"scaling": scaling, "failover": mttr, "resume": identity}
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    tmpdir = tempfile.mkdtemp(prefix="atpu-fleet-")
+    result = asyncio.run(run_bench(tmpdir))
+    base = result["scaling"]["1"]["goodput_rps"] or 1.0
+    speedup4 = round((result["scaling"]["4"]["goodput_rps"] or 0.0) / base, 2)
+    cores = len(os.sched_getaffinity(0))
+    ok = (
+        result["resume"].get("token_identical") is True
+        and result["failover"].get("mttr_s") is not None
+        and all(v["errors"] == 0 for v in result["scaling"].values())
+    )
+    doc = {
+        # the robustness headline: caller-visible outage when a replica of
+        # a 2-replica fleet is SIGKILLed under steady traffic (a survivor
+        # keeps serving; compare engine_sigkill MTTR ~1s and llm respawn
+        # ~2-3s in BENCH_chaos.json for the single-replica story)
+        "metric": "fleet_failover_mttr_s",
+        "value": result["failover"].get("mttr_s"),
+        "unit": "s caller-visible gap, 2 replicas, one killed",
+        "goodput_speedup_4x_replicas": speedup4,
+        "host_cores": cores,
+        # capacity scaling needs >= N cores (or N TPU hosts): replicas are
+        # separate PROCESSES, so on a 1-core CI box the sweep measures
+        # time-slicing overhead, not parallel capacity — the sweep is
+        # recorded for the p99/goodput shape, the MTTR and token-identity
+        # tiers are the hardware-independent assertions
+        "scaling_note": (
+            "positive goodput scaling requires >= replicas cores; "
+            f"this host has {cores}"
+        ),
+        "seed": SEED,
+        "smoke": SMOKE,
+        "platform": os.environ.get("JAX_PLATFORMS", ""),
+        "pass": ok,
+        **result,
+        "wall_s": round(time.monotonic() - t0, 1),
+    }
+    write_artifact("BENCH_fleet.json", doc)
+    if not ok:
+        print(f"FLEET BENCH FAILED: {json.dumps(result)[:600]}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

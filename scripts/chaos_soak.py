@@ -17,6 +17,18 @@ subprocesses — through deterministic fault phases:
                     subprocess (per-request isolation: the engine survives)
   llm_sigkill       SIGKILL the LLM host process, then token-identical
                     session resume from the KV snapshot
+  replica_failover  2-replica LLM fleet: SIGKILL the replica serving a
+                    session MID-DECODE; the journaled turn settles on the
+                    SURVIVOR with a token-identical continuation (restored
+                    from the store-durable snapshot), and the next live
+                    turn matches the control session bit for bit
+  lease_flap        replica.lease failpoint starves heartbeat refreshes on
+                    a healthy 2-replica echo fleet: replicas flap SUSPECT
+                    (excluded from routing) and return ALIVE when the
+                    budget is spent — service never degrades below 200s
+  route_dead        router.pick failpoint returns stale (dead) replica
+                    choices while one echo replica is down: the bounded
+                    retry-on-next-replica absorbs every stale pick
   torn_aof          truncate the native store's AOF mid-record; reopen
                     recovers every complete record and keeps appending
 
@@ -95,6 +107,14 @@ class Soak:
         cfg.cadences.metrics_interval_s = 5.0
         cfg.resilience.restart_backoff_base_s = 0.2
         cfg.resilience.breaker_cooldown_s = 0.5
+        # fleet: tight lease windows so replica death detection is observed
+        # within the soak's budget, not the production 3s/6s defaults.
+        # fleet.replicas stays 1 — only the explicitly-pinned fleet agents
+        # run multi-replica, every other agent is the pre-fleet baseline.
+        cfg.fleet.lease_interval_s = 0.25
+        cfg.fleet.suspect_after_s = 1.0
+        cfg.fleet.dead_after_s = 2.0
+        os.environ["ATPU_JITTER_SEED"] = str(SEED)
         backend = LocalBackend(
             data_dir=self.tmpdir,
             ready_timeout_s=90.0,
@@ -123,7 +143,9 @@ class Soak:
         if self.client is not None:
             await self.client.close()
 
-    async def deploy(self, name: str, model, auto_restart: bool = True, env=None) -> str:
+    async def deploy(
+        self, name: str, model, auto_restart: bool = True, env=None, replicas: int = 0
+    ) -> str:
         resp = await self.client.post(
             "/agents",
             json={
@@ -131,6 +153,7 @@ class Soak:
                 "model": model,
                 "auto_restart": auto_restart,
                 "env": env or {},
+                "replicas": replicas,
             },
             headers=AUTH,
         )
@@ -270,6 +293,23 @@ class Soak:
         journal entries stay replayable (no acked loss, settled like any
         other phase's traffic) — never a 5xx crash; the engine serves on
         and its metrics count the exhaustions."""
+        # the paged engine may still be LOADING (five tiny-LLM hosts boot
+        # concurrently in this soak): wait until the model is loaded before
+        # asserting on backpressure — a 502 during model load is the
+        # loading contract, not a pool-exhaustion crash. Readiness is read
+        # from /metrics, NOT by serving probe chats: a probe would burn the
+        # armed engine.page_alloc fire budget before the phase's own
+        # traffic gets to observe the injected exhaustion.
+        agent = self.services.manager.get_agent(paged_id)
+        t_warm = time.monotonic()
+        while time.monotonic() - t_warm < 60.0:
+            stats = self.services.backend.stats(agent.engine_id) or {}
+            if stats.get("model_loaded"):
+                break
+            await asyncio.sleep(0.5)
+        else:
+            self.violations.append("page_exhaustion: paged engine never loaded")
+            return False
         saw_backpressure = False
         for i in range(6):
             # distinct sessions grow the pool toward organic exhaustion;
@@ -362,6 +402,263 @@ class Soak:
             )
             return False
         return True
+
+    def _affine_replica(self, agent_id: str, session: str) -> str:
+        """Which replica the router pinned a session to (the kill target)."""
+        router = self.services.router
+        with router._lock:
+            return router._affinity.get((agent_id, session), "")
+
+    async def phase_replica_failover(self, fleet_id: str) -> bool:
+        """Mid-decode failover on a 2-replica LLM fleet. The control
+        session runs turn1+turn2 clean. The victim session runs turn1,
+        then turn2 is fired and the replica SERVING it is SIGKILLed while
+        the decode is in flight. The journaled turn must settle COMPLETED
+        on the SURVIVOR (session restored from the store-durable snapshot)
+        with a response token-identical to the control's, and the next
+        LIVE turn must match the control's turn3 bit for bit."""
+
+        async def turn(session: str, message: str, n: int = 12):
+            resp = await self.client.post(
+                f"/agent/{fleet_id}/chat",
+                data=json.dumps(
+                    {
+                        "message": message,
+                        "session": session,
+                        "max_tokens": n,
+                        "ignore_eos": True,
+                    }
+                ),
+            )
+            doc = await resp.json()
+            rid = resp.headers.get("X-Agentainer-Request-ID", "")
+            return resp.status, doc.get("response", ""), rid
+
+        # both replicas must be past model load: the phase's very first
+        # turn asserts a 200, and a replica still LOADING would 502 it
+        agent = self.services.manager.get_agent(fleet_id)
+        t_warm = time.monotonic()
+        for eid in agent.all_engine_ids():
+            while time.monotonic() - t_warm < 90.0:
+                stats = self.services.backend.stats(eid) or {}
+                if stats.get("model_loaded"):
+                    break
+                await asyncio.sleep(0.5)
+            else:
+                self.violations.append(
+                    f"replica_failover: replica {eid} never loaded"
+                )
+                return False
+
+        status, _, _ = await turn("fctl", "alpha alpha alpha")
+        assert status == 200, f"fleet ctl turn1 got {status}"
+        status, ctl_t2, _ = await turn("fctl", "beta beta", n=32)
+        assert status == 200, f"fleet ctl turn2 got {status}"
+        status, ctl_t3, _ = await turn("fctl", "gamma", n=12)
+        assert status == 200, f"fleet ctl turn3 got {status}"
+        status, _, _ = await turn("fvic", "alpha alpha alpha")
+        assert status == 200, f"fleet vic turn1 got {status}"
+        # the failover resume restores from the durable snapshot: wait for
+        # the victim session's snapshot to land (same contract as
+        # phase_llm_resume — never landing is itself a violation)
+        kv_key = f"agent:{fleet_id}:kvcache:fvic"
+        t_snap = time.monotonic()
+        while self.services.store.get(kv_key) is None:
+            if time.monotonic() - t_snap > 45.0:
+                self.violations.append(
+                    "replica_failover: KV snapshot never landed"
+                )
+                return False
+            await asyncio.sleep(0.25)
+
+        victim_replica = self._affine_replica(fleet_id, "fvic")
+        if not victim_replica:
+            self.violations.append("replica_failover: no session affinity recorded")
+            return False
+        # fire turn2 and kill the serving replica MID-DECODE: the armed
+        # decode_step delay makes the 32-token turn take >= 0.6 s, so
+        # 0.25 s in the request is past prefill and inside the decode loop
+        t2_task = asyncio.ensure_future(turn("fvic", "beta beta", n=32))
+        await asyncio.sleep(0.25)
+        t_kill = time.monotonic()
+        self.services.backend.kill_engine_hard(victim_replica)
+        status, live_t2, rid = await t2_task
+        # two legitimate outcomes: the dispatch died mid-flight (5xx; the
+        # journaled entry replays onto the survivor) or the kill landed
+        # before/after the forward and the bounded retry served it live
+        if status == 200:
+            if live_t2 != ctl_t2:
+                self.violations.append(
+                    f"replica_failover: live turn2 diverged: {live_t2!r} != {ctl_t2!r}"
+                )
+                return False
+        else:
+            if not rid:
+                self.violations.append(
+                    f"replica_failover: turn2 got {status} with no request id"
+                )
+                return False
+            # the acked-by-journal turn must settle COMPLETED on the
+            # survivor with the token-identical continuation
+            deadline = time.monotonic() + RECOVERY_CAP_S
+            req = None
+            while time.monotonic() < deadline:
+                req = self.services.journal.get(fleet_id, rid)
+                if req is not None and req.status == "completed":
+                    break
+                await asyncio.sleep(0.25)
+            if req is None or req.status != "completed":
+                self.violations.append(
+                    "replica_failover: mid-decode turn never settled "
+                    f"({None if req is None else req.status})"
+                )
+                return False
+            import base64 as _b64
+
+            body = _b64.b64decode((req.response or {}).get("body_b64", "") or "")
+            try:
+                archived = json.loads(body).get("response", "")
+            except Exception:
+                archived = ""
+            if archived != ctl_t2:
+                self.violations.append(
+                    f"replica_failover: archived turn2 diverged: "
+                    f"{archived!r} != {ctl_t2!r}"
+                )
+                return False
+        # fleet-level MTTR: the agent as a whole keeps serving through the
+        # survivor — measured as time-to-next-200 on a throwaway session
+        t0 = time.monotonic()
+        recovered = False
+        while time.monotonic() - t0 < RECOVERY_CAP_S:
+            s, _, _ = await turn("fprobe", "ping", n=4)
+            if s == 200:
+                recovered = True
+                break
+            await asyncio.sleep(0.2)
+        self.mttr["replica_failover"] = (
+            round(time.monotonic() - t_kill, 3) if recovered else -1.0
+        )
+        if not recovered:
+            self.violations.append("replica_failover: fleet never served again")
+            return False
+        # the next LIVE victim turn continues the spliced session exactly.
+        # Routing is deterministic here because EVERY dispatcher (including
+        # the replay worker that settled turn2) parses the session hint:
+        # fvic's affinity follows the replica that actually executed the
+        # failover turn — usually the survivor; the respawned victim only
+        # if it came back in time to execute turn2 itself, in which case
+        # ITS resident context is equally correct. Either way turn3 lands
+        # on the replica holding turn1+turn2, never on a stale restore.
+        if not self._affine_replica(fleet_id, "fvic"):
+            self.violations.append(
+                "replica_failover: failover dispatch recorded no affinity"
+            )
+            return False
+        status, vic_t3, _ = await turn("fvic", "gamma", n=12)
+        if status != 200:
+            self.violations.append(f"replica_failover: vic turn3 got {status}")
+            return False
+        if vic_t3 != ctl_t3:
+            self.violations.append(
+                f"replica_failover: post-failover turn diverged: "
+                f"{vic_t3!r} != {ctl_t3!r}"
+            )
+            return False
+        return True
+
+    async def phase_lease_flap(self, fleet_echo_id: str) -> bool:
+        """Heartbeat starvation without a death: the replica.lease
+        failpoint fails refreshes until its budget is spent, so healthy
+        replicas flap SUSPECT (routing excludes them; the pick falls back
+        to try-anyway when every replica is excluded). Service must stay
+        at 200s throughout, and every replica must return ALIVE."""
+        mon = self.services.replica_monitor
+        before = mon.suspects_total
+        # budget sizing: the monitor refreshes EVERY multi-replica lease
+        # each 0.25s tick (4 replicas across both fleets = 16 fires/s), so
+        # 24 fires ≈ 1.5s of starvation — past suspect_after_s (1.0) but
+        # safely short of dead_after_s (2.0): flapping, not death
+        faults.arm(
+            "replica.lease", error="ConnectionError", probability=1.0, count=24
+        )
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 4.0:
+            status, msg = await self.chat(fleet_echo_id, session="flap")
+            if status != 200:
+                self.violations.append(f"lease_flap: {msg} got {status}")
+            await asyncio.sleep(0.25)
+        faults.disarm("replica.lease")
+        if mon.suspects_total <= before:
+            self.violations.append(
+                "lease_flap: no SUSPECT transition observed (lease seam not wired?)"
+            )
+            return False
+        # refreshes resume: every replica must settle back to ALIVE
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            states = mon.states(fleet_echo_id)
+            if states and set(states.values()) == {"alive"}:
+                return True
+            await asyncio.sleep(0.25)
+        self.violations.append(
+            f"lease_flap: replicas never returned ALIVE: {mon.states(fleet_echo_id)}"
+        )
+        return False
+
+    async def phase_route_dead(self, fleet_echo_id: str) -> bool:
+        """Stale routing state: one replica is SIGKILLed, the monitor is
+        given time to mark it SUSPECT, then router.pick is armed (seeded
+        50%) to hand the dead/excluded replica back anyway. Requests in
+        the window must be absorbed by the bounded retry-on-next-replica
+        (200 via the survivor) or at worst take the durable 502-pending
+        path and drain later — never lost. The DEAD transition then fires
+        fleet repair, which respawns the victim (the agent has no
+        auto_restart watcher, so repair IS the recovery path here)."""
+        agent = self.services.manager.get_agent(fleet_echo_id)
+        victim = agent.all_engine_ids()[-1]
+        router = self.services.router
+        stale_before = router.stale_picks_total
+        self.services.backend.kill_engine_hard(victim)
+        # lease must age past suspect_after_s (1.0) so the victim is
+        # actually EXCLUDED — that's what makes a fired pick "stale"
+        await asyncio.sleep(1.3)
+        faults.arm(
+            "router.pick", error="FaultInjected", probability=0.5, seed=SEED, count=12
+        )
+        ok200 = 0
+        for i in range(8):
+            status, msg = await self.chat(fleet_echo_id, session=f"rd-{i}")
+            if status == 200:
+                ok200 += 1
+            elif status not in (202, 502):
+                self.violations.append(f"route_dead: {msg} got {status}")
+            await asyncio.sleep(0.1)
+        faults.disarm("router.pick")
+        if router.stale_picks_total <= stale_before:
+            self.violations.append(
+                "route_dead: failpoint never produced a stale pick "
+                "(seam not wired?)"
+            )
+            return False
+        if ok200 == 0:
+            self.violations.append(
+                "route_dead: no request reached the survivor during the window"
+            )
+            return False
+        # repair (DEAD at 2s) respawns the victim: the fleet heals itself
+        await self.probe_until_ok(fleet_echo_id, "route_dead")
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            states = self.services.replica_monitor.states(fleet_echo_id)
+            if states and set(states.values()) == {"alive"}:
+                return True
+            await asyncio.sleep(0.5)
+        self.violations.append(
+            "route_dead: victim replica never repaired to ALIVE: "
+            f"{self.services.replica_monitor.states(fleet_echo_id)}"
+        )
+        return False
 
     # -- invariant settlement ---------------------------------------------
     async def settle(self, agent_ids: list[str]) -> dict:
@@ -492,6 +789,47 @@ async def run_soak(tmpdir: str) -> dict:
             },
             env={"ATPU_FAULTS": "engine.prefill:error=RuntimeError,count=2"},
         )
+        # 2-replica fleets: the echo fleet exercises lease flapping and
+        # stale routing (auto_restart OFF — fleet repair must be the thing
+        # that revives a dead replica); the LLM fleet exercises mid-decode
+        # failover with token-identical resume on the survivor. Fleet
+        # replicas of one agent never share a host process (replica
+        # ordinal is in the share key), so killing one leaves the other.
+        fleet_echo_id = await soak.deploy(
+            "chaos-fleet-echo", "echo", auto_restart=False, replicas=2
+        )
+        fleet_llm_id = await soak.deploy(
+            "chaos-fleet-llm",
+            {
+                "engine": "llm",
+                "config": "tiny",
+                # speculative OFF: prompt-lookup drafting can finish a
+                # 32-token repetitive turn in <0.15s, turning the phase's
+                # "mid-decode" kill into a completed-but-not-yet-durable
+                # kill (the PR-5 durability-floor window, asserted by the
+                # llm_sigkill phase instead). Plain decode makes the kill
+                # land deterministically inside the decode loop, which is
+                # the failover case this phase exists to pin.
+                "options": {
+                    "max_batch": 2,
+                    "max_seq": 256,
+                    "prefill_chunk": 64,
+                    "kv_snapshot_interval_s": 0.5,
+                    "speculative": False,
+                },
+            },
+            replicas=2,
+            # delay-only decode failpoint in BOTH replicas' engines: the
+            # tiny CPU model decodes 32 plain tokens in well under the
+            # 0.15s kill offset, so without it the "mid-decode" kill
+            # lands after completion (the PR-5 durability-floor window,
+            # already pinned by llm_sigkill). 150 ms per decode chunk
+            # makes a 32-token turn take >= 0.6 s on every machine —
+            # the kill deterministically interrupts the decode loop.
+            # Symmetric across replicas and delay-only: greedy token
+            # streams are unchanged, so the control comparison holds.
+            env={"ATPU_FAULTS": "engine.decode_step:error=none,delay_ms=150"},
+        )
         paged_id = await soak.deploy(
             "chaos-paged",
             {
@@ -519,10 +857,18 @@ async def run_soak(tmpdir: str) -> dict:
         await soak.phase_poisoned_prefill(poison_id)
         backpressured = await soak.phase_page_exhaustion(paged_id)
         token_identical = await soak.phase_llm_resume(llm_id)
+        lease_ok = await soak.phase_lease_flap(fleet_echo_id)
+        route_ok = await soak.phase_route_dead(fleet_echo_id)
+        failover_ok = await soak.phase_replica_failover(fleet_llm_id)
 
-        inv = await soak.settle([echo_id, poison_id, paged_id, llm_id])
+        inv = await soak.settle(
+            [echo_id, poison_id, paged_id, llm_id, fleet_echo_id, fleet_llm_id]
+        )
         inv["token_identical_resume"] = token_identical
         inv["page_exhaustion_backpressure"] = backpressured
+        inv["lease_flap_recovers"] = lease_ok
+        inv["route_dead_absorbed"] = route_ok
+        inv["replica_failover_token_identical"] = failover_ok
     finally:
         await soak.stop()
     aof = torn_aof_check(tmpdir)

@@ -1,0 +1,490 @@
+"""Replica fleet: multi-replica lifecycle, health-aware routing, session
+affinity + handoff, bounded cross-replica retry, per-replica breakers,
+lease-driven replica states, fleet repair, and the Retry-After jitter.
+
+The fleet's correctness story rides invariants pinned elsewhere (journal
+CAS admission, engine idempotency memo, token-identical snapshot resume);
+these tests pin the NEW composition: the router only ever engages for
+agents with more than one replica, and ``fleet.replicas=1`` (the default)
+produces records and dispatch behavior identical to pre-fleet.
+"""
+
+import asyncio
+import json
+import random
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from agentainer_tpu.config import Config
+from agentainer_tpu.core.resilience import KeyedBreakers, retry_after_jitter
+from agentainer_tpu.core.spec import AgentStatus
+from agentainer_tpu.daemon import build_services
+from agentainer_tpu.manager.health import (
+    REPLICA_ALIVE,
+    REPLICA_DEAD,
+    REPLICA_SUSPECT,
+    ReplicaMonitor,
+)
+from agentainer_tpu.manager.reconcile import FleetRepair
+from agentainer_tpu.runtime.backend import EngineState, FakeBackend
+from agentainer_tpu.server.router import ReplicaRouter
+from agentainer_tpu.store import Keys, MemoryStore
+
+TOKEN = "test-token"
+AUTH = {"Authorization": f"Bearer {TOKEN}"}
+
+
+def make_services(tmp_path, fleet_replicas=1):
+    cfg = Config()
+    cfg.auth_token = TOKEN
+    cfg.fleet.replicas = fleet_replicas
+    return build_services(
+        config=cfg,
+        store=MemoryStore(),
+        backend=FakeBackend(),
+        console_logs=False,
+        data_dir=str(tmp_path),
+    )
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def client_for(services) -> TestClient:
+    client = TestClient(TestServer(services.app))
+    await client.start_server()
+    return client
+
+
+async def deploy_and_start(client, name="a", model="echo", replicas=0):
+    body = {"name": name, "model": model}
+    if replicas:
+        body["replicas"] = replicas
+    resp = await client.post("/agents", json=body, headers=AUTH)
+    assert resp.status == 200, await resp.text()
+    agent = (await resp.json())["data"]
+    resp = await client.post(f"/agents/{agent['id']}/start", headers=AUTH)
+    assert resp.status == 200, await resp.text()
+    return agent
+
+
+# -- lifecycle ------------------------------------------------------------
+
+
+def test_single_replica_record_is_pre_fleet_shape(tmp_path):
+    """fleet.replicas=1 (default): one engine, replica_ids stays empty —
+    the durable record is indistinguishable from a pre-fleet deployment."""
+
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        agent = await deploy_and_start(client)
+        rec = services.manager.get_agent(agent["id"])
+        assert rec.engine_id
+        assert rec.replica_ids == []
+        assert rec.all_engine_ids() == [rec.engine_id]
+        assert len(services.backend.list_engines()) == 1
+        await client.close()
+
+    run(body())
+
+
+def test_multi_replica_start_spawns_n_engines(tmp_path):
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        agent = await deploy_and_start(client, replicas=3)
+        rec = services.manager.get_agent(agent["id"])
+        assert len(rec.replica_ids) == 3
+        assert rec.engine_id == rec.replica_ids[0]
+        infos = [services.backend.engine_info(e) for e in rec.replica_ids]
+        assert all(i is not None and i.state == EngineState.RUNNING for i in infos)
+        # each replica registered an initial lease
+        for eid in rec.replica_ids:
+            assert services.store.get_json(Keys.replica_lease(rec.id, eid))
+        await client.close()
+
+    run(body())
+
+
+def test_fleet_default_applies_when_deploy_does_not_pin(tmp_path):
+    async def body():
+        services = make_services(tmp_path, fleet_replicas=2)
+        client = await client_for(services)
+        agent = await deploy_and_start(client)  # no per-deploy replicas
+        rec = services.manager.get_agent(agent["id"])
+        assert len(rec.all_engine_ids()) == 2
+        await client.close()
+
+    run(body())
+
+
+def test_stop_and_remove_cover_every_replica(tmp_path):
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        agent = await deploy_and_start(client, replicas=2)
+        rec = services.manager.get_agent(agent["id"])
+        ids = rec.all_engine_ids()
+        resp = await client.post(f"/agents/{rec.id}/stop", headers=AUTH)
+        assert resp.status == 200
+        for eid in ids:
+            assert services.backend.engine_info(eid).state == EngineState.EXITED
+        resp = await client.delete(f"/agents/{rec.id}", headers=AUTH)
+        assert resp.status == 200
+        assert services.backend.list_engines() == []
+        assert services.store.keys(Keys.replica_lease_pattern(rec.id)) == []
+        await client.close()
+
+    run(body())
+
+
+# -- routing --------------------------------------------------------------
+
+
+def _mk_router(tmp_path, n=3, seed=7):
+    services = make_services(tmp_path)
+    agent = services.manager.deploy(name="r", model="echo", replicas=n)
+    services.manager.start(agent.id)
+    agent = services.manager.get_agent(agent.id)
+    router = ReplicaRouter(services.manager, services.config.fleet, seed=seed)
+    return services, agent, router
+
+
+def test_router_session_affinity_sticks(tmp_path):
+    services, agent, router = _mk_router(tmp_path)
+    first = router.pick(agent, session="s1")
+    for _ in range(5):
+        again = router.pick(agent, session="s1")
+        assert again.engine_id == first.engine_id
+
+
+def test_router_power_of_two_prefers_less_loaded(tmp_path):
+    services, agent, router = _mk_router(tmp_path, n=2)
+    a, b = agent.all_engine_ids()
+    for _ in range(8):
+        router.begin(a)  # a is drowning in in-flight work
+    picks = {router.pick(agent).engine_id for _ in range(10)}
+    assert picks == {b}
+
+
+def test_router_excludes_suspect_and_dead(tmp_path):
+    services, agent, router = _mk_router(tmp_path, n=3)
+    a, b, c = agent.all_engine_ids()
+    router.set_health(a, "suspect")
+    router.set_health(b, "dead")
+    picks = {router.pick(agent).engine_id for _ in range(10)}
+    assert picks == {c}
+
+
+def test_router_handoff_on_dead_affinity(tmp_path):
+    """A session pinned to a replica that dies re-pins to a survivor and
+    the handoff is counted — the failover path the chaos soak exercises
+    end-to-end with real engines."""
+    services, agent, router = _mk_router(tmp_path, n=3)
+    first = router.pick(agent, session="vic")
+    router.on_replica_dead(agent.id, first.engine_id)
+    second = router.pick(agent, session="vic")
+    assert second.engine_id != first.engine_id
+    assert router.handoffs_total == 0  # affinity was dropped, fresh pick
+    # a live affinity to an unhealthy (but not dead-notified) replica is a
+    # true HANDOFF: counted, and the session re-pins to a healthy survivor
+    router.set_health(second.engine_id, "suspect")
+    third = router.pick(agent, session="vic")
+    assert third.engine_id != second.engine_id
+    assert router.handoffs_total == 1
+
+
+def test_router_per_replica_breaker_isolates(tmp_path):
+    """One replica's open breaker must not refuse the agent: picks flow to
+    the healthy replica, and the broken one's state is visible in stats."""
+    services, agent, router = _mk_router(tmp_path, n=2)
+    a, b = agent.all_engine_ids()
+    for _ in range(router.breakers.failure_threshold):
+        router.end(a, ok=False)
+    assert router.breakers.get(a).state == "open"
+    picks = {router.pick(agent).engine_id for _ in range(10)}
+    assert picks == {b}
+    stats = router.stats(agent)
+    assert stats["replicas"][a]["breaker"]["state"] == "open"
+    assert stats["replicas"][b]["breaker"]["state"] == "closed"
+
+
+def test_router_all_excluded_falls_back_to_probe(tmp_path):
+    """Every replica unhealthy: the pick degrades to try-anyway (the
+    dispatch attempt is the probe) instead of refusing outright."""
+    services, agent, router = _mk_router(tmp_path, n=2)
+    for eid in agent.all_engine_ids():
+        router.set_health(eid, "suspect")
+    assert router.pick(agent) is not None
+    # ...but an exclude list covering everything is a hard None
+    assert router.pick(agent, exclude=frozenset(agent.all_engine_ids())) is None
+
+
+# -- dispatch: cross-replica retry ---------------------------------------
+
+
+def test_dispatch_retries_on_next_replica_after_crash(tmp_path):
+    """Primary crashes (connection refused): the proxied request is
+    transparently retried on a surviving replica and answers 200 — the
+    caller never sees the death. The journal entry settles COMPLETED."""
+
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        agent = await deploy_and_start(client, replicas=2)
+        rec = services.manager.get_agent(agent["id"])
+        services.backend.crash_engine(rec.engine_id)  # kill the primary
+        resp = await client.post(
+            f"/agent/{rec.id}/chat", data=json.dumps({"message": "hi", "session": "s"})
+        )
+        assert resp.status == 200, await resp.text()
+        rid = resp.headers.get("X-Agentainer-Request-ID", "")
+        if rid:
+            req = services.journal.get(rec.id, rid)
+            assert req is not None and req.status == "completed"
+            # the claim was RE-ATTRIBUTED to the replica that actually
+            # served it — fleet repair keys off this, so a stale primary
+            # attribution would let repair reset work the survivor ran
+            assert req.replica_id and req.replica_id != rec.engine_id
+        await client.close()
+
+    run(body())
+
+
+def test_dispatch_all_replicas_down_leaves_pending(tmp_path):
+    """Every replica refuses: pre-fleet crash heuristic — 502, entry stays
+    pending for the replay worker (no acked loss, no retry charged)."""
+
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        agent = await deploy_and_start(client, replicas=2)
+        rec = services.manager.get_agent(agent["id"])
+        for eid in rec.all_engine_ids():
+            services.backend.crash_engine(eid)
+        # keep the RECORD running (crash not yet reconciled) so the proxy
+        # dispatches instead of queueing at the door
+        rec.status = AgentStatus.RUNNING
+        services.manager.save_agent(rec)
+        resp = await client.post(
+            f"/agent/{rec.id}/chat", data=json.dumps({"message": "hi"})
+        )
+        assert resp.status == 502
+        assert services.journal.stats(rec.id)["pending"] == 1
+        await client.close()
+
+    run(body())
+
+
+# -- replica monitor + fleet repair ---------------------------------------
+
+
+def _mk_monitor(tmp_path, n=2, suspect=0.05, dead=0.5):
+    services = make_services(tmp_path)
+    agent = services.manager.deploy(name="m", model="echo", replicas=n)
+    services.manager.start(agent.id)
+    agent = services.manager.get_agent(agent.id)
+    router = ReplicaRouter(services.manager, services.config.fleet, seed=3)
+    repair = FleetRepair(
+        services.manager, services.journal, router=router, replay=None
+    )
+    mon = ReplicaMonitor(
+        services.manager,
+        services.store,
+        router=router,
+        repair=repair,
+        lease_ttl_s=5.0,
+        lease_interval_s=0.01,
+        suspect_after_s=suspect,
+        dead_after_s=dead,
+    )
+    return services, agent, router, repair, mon
+
+
+def test_monitor_leases_and_states(tmp_path):
+    services, agent, router, repair, mon = _mk_monitor(tmp_path)
+    mon.tick()
+    assert set(mon.states(agent.id).values()) == {REPLICA_ALIVE}
+    for eid in agent.all_engine_ids():
+        assert services.store.get_json(Keys.replica_lease(agent.id, eid))
+
+
+def test_monitor_suspects_then_kills_then_repairs(tmp_path):
+    services, agent, router, repair, mon = _mk_monitor(tmp_path)
+    victim = agent.all_engine_ids()[1]
+    mon.tick()  # fresh leases
+    services.backend.crash_engine(victim)  # probe now fails; lease ages
+    # windows are wide apart (0.05 suspect / 0.5 dead) so scheduler jitter
+    # on a loaded CI box cannot skip the SUSPECT observation
+    time.sleep(0.1)
+    mon.tick()
+    assert mon.states(agent.id)[victim] == REPLICA_SUSPECT
+    assert router.health_of(victim) == REPLICA_SUSPECT
+    time.sleep(0.45)
+    mon.tick()
+    # DEAD fired repair: FakeBackend.start_engine revived the engine
+    assert repair.repairs_total == 1
+    assert services.backend.engine_info(victim).state == EngineState.RUNNING
+    mon.tick()
+    assert mon.states(agent.id)[victim] == REPLICA_ALIVE
+    assert router.health_of(victim) == REPLICA_ALIVE
+
+
+def test_monitor_skips_single_replica_agents(tmp_path):
+    """fleet.replicas=1: zero lease traffic — the A/B baseline."""
+    services = make_services(tmp_path)
+    agent = services.manager.deploy(name="solo", model="echo")
+    services.manager.start(agent.id)
+    mon = ReplicaMonitor(services.manager, services.store)
+    mon.tick()
+    assert services.store.keys(Keys.replica_lease_pattern(agent.id)) == []
+    assert mon.lease_refreshes_total == 0
+
+
+def test_repair_reassigns_in_flight_journal_work(tmp_path):
+    """A dead replica's PROCESSING entries return to PENDING immediately
+    (attributed via acquire_processing), ready for a survivor's dispatch."""
+    services = make_services(tmp_path)
+    agent = services.manager.deploy(name="j", model="echo", replicas=2)
+    services.manager.start(agent.id)
+    agent = services.manager.get_agent(agent.id)
+    dead, alive = agent.all_engine_ids()
+    j = services.journal
+    r1 = j.store_request(agent.id, "POST", "/chat")
+    r2 = j.store_request(agent.id, "POST", "/chat")
+    assert j.acquire_processing(agent.id, r1.id, replica_id=dead)
+    assert j.acquire_processing(agent.id, r2.id, replica_id=alive)
+    repair = FleetRepair(services.manager, j, router=None, replay=None)
+    out = repair.repair_replica(agent.id, dead)
+    assert out["reassigned"] == 1
+    assert j.get(agent.id, r1.id).status == "pending"
+    assert j.get(agent.id, r2.id).status == "processing"  # survivor untouched
+
+
+def test_quicksync_promotes_surviving_replica(tmp_path):
+    """Primary dies: the agent stays RUNNING (a fleet is up while any
+    replica is) and engine_id re-points at a survivor."""
+    services = make_services(tmp_path)
+    agent = services.manager.deploy(name="q", model="echo", replicas=2)
+    services.manager.start(agent.id)
+    agent = services.manager.get_agent(agent.id)
+    primary, secondary = agent.all_engine_ids()
+    services.backend.crash_engine(primary)
+    synced = services.quick_sync.sync_agent(agent.id)
+    assert synced.status == AgentStatus.RUNNING
+    assert synced.engine_id == secondary
+
+
+def test_quicksync_all_dead_stops_agent(tmp_path):
+    services = make_services(tmp_path)
+    agent = services.manager.deploy(name="q2", model="echo", replicas=2)
+    services.manager.start(agent.id)
+    agent = services.manager.get_agent(agent.id)
+    for eid in agent.all_engine_ids():
+        services.backend.crash_engine(eid)
+    synced = services.quick_sync.sync_agent(agent.id)
+    assert synced.status == AgentStatus.STOPPED
+
+
+# -- /metrics fleet surface ----------------------------------------------
+
+
+def test_metrics_export_per_replica_breakers(tmp_path):
+    async def body():
+        services = make_services(tmp_path)
+        client = await client_for(services)
+        agent = await deploy_and_start(client, replicas=2)
+        # drive one dispatch so the router has seen the replicas
+        await client.post(f"/agent/{agent['id']}/chat", data=json.dumps({"message": "x"}))
+        resp = await client.get(f"/agents/{agent['id']}/metrics", headers=AUTH)
+        doc = (await resp.json())["data"]
+        assert "fleet" in doc
+        rec = services.manager.get_agent(agent["id"])
+        for eid in rec.all_engine_ids():
+            assert "breaker" in doc["fleet"]["replicas"][eid]
+        # single-replica agents keep the pre-fleet metrics shape
+        solo = await deploy_and_start(client, name="solo2")
+        resp = await client.get(f"/agents/{solo['id']}/metrics", headers=AUTH)
+        assert "fleet" not in ((await resp.json())["data"] or {})
+        await client.close()
+
+    run(body())
+
+
+# -- Retry-After jitter ---------------------------------------------------
+
+
+def test_retry_after_jitter_bounds_and_determinism():
+    rng = random.Random(42)
+    vals = [retry_after_jitter(10.0, rng) for _ in range(200)]
+    assert all(7 <= v <= 13 for v in vals)  # 10s ± 25%
+    assert len(set(vals)) > 1  # actually jittered
+    rng2 = random.Random(42)
+    assert vals == [retry_after_jitter(10.0, rng2) for _ in range(200)]
+    assert retry_after_jitter(0.01, random.Random(1)) >= 1  # floor
+
+
+def test_shed_responses_carry_jittered_retry_after(tmp_path, monkeypatch):
+    """The 429 shed path answers with the jittered Retry-After: pinned by
+    seeding the app's RNG and comparing against the same seeded sequence."""
+    monkeypatch.setenv("ATPU_JITTER_SEED", "99")
+
+    async def body():
+        services = make_services(tmp_path)
+        services.config.deadlines.shed_pending_per_agent = 1
+        services.config.deadlines.retry_after_s = 10.0
+        client = await client_for(services)
+        agent = await deploy_and_start(client)
+        rec = services.manager.get_agent(agent["id"])
+        # stopped agent + pre-filled pending queue beyond the watermark
+        await client.post(f"/agents/{rec.id}/stop", headers=AUTH)
+        services.journal.store_request(rec.id, "POST", "/chat")
+        services.journal.store_request(rec.id, "POST", "/chat")
+        expected_rng = random.Random(99)
+        resp = await client.post(
+            f"/agent/{rec.id}/chat", data=json.dumps({"message": "x"})
+        )
+        assert resp.status == 429
+        got = int(resp.headers["Retry-After"])
+        assert got == retry_after_jitter(10.0, expected_rng)
+        assert 7 <= got <= 13
+        await client.close()
+
+    run(body())
+
+
+def test_keyed_breakers_independent():
+    kb = KeyedBreakers(failure_threshold=2, cooldown_s=60.0)
+    for _ in range(2):
+        kb.get("a").fail()
+    assert kb.get("a").state == "open"
+    assert kb.get("b").state == "closed"
+    kb.drop("a")
+    assert kb.get("a").state == "closed"  # fresh breaker after drop
+
+
+def test_local_backend_replicas_share_agent_store_token(tmp_path):
+    """The per-agent store credential is agent-scoped: a second replica's
+    create_engine must REUSE it, not mint-and-overwrite (which would 401
+    the first replica's snapshot/conversation writes mid-flight)."""
+    from agentainer_tpu.core.spec import Agent, ModelRef
+    from agentainer_tpu.runtime.local import LocalBackend
+
+    store = MemoryStore()
+    backend = LocalBackend(store=store, data_dir=str(tmp_path))
+    try:
+        agent = Agent(id="agent-tok", name="tok", model=ModelRef(engine="echo"))
+        e0 = backend.create_engine(agent, (0,), replica_index=0)
+        tok0 = store.get(Keys.internal_token(agent.id))
+        e1 = backend.create_engine(agent, (0,), replica_index=1)
+        tok1 = store.get(Keys.internal_token(agent.id))
+        assert tok0 == tok1
+        env0 = backend._recs[e0].env["AGENTAINER_INTERNAL_TOKEN"]
+        env1 = backend._recs[e1].env["AGENTAINER_INTERNAL_TOKEN"]
+        assert env0 == env1 == tok0.decode()
+    finally:
+        backend.close()

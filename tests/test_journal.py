@@ -86,3 +86,55 @@ def test_idempotency_key_roundtrip():
     req = j.store_request("a1", "POST", "/chat", request_id="fixed-id")
     assert req.id == "fixed-id"
     assert j.get("a1", "fixed-id") is not None
+
+
+def test_acquire_processing_contention_across_store_clients():
+    """The fleet's actual dispatcher shape: two dispatchers racing the
+    pending→processing CAS on the SAME entry through SEPARATE store client
+    objects (each with its own journal instance — no shared Python-level
+    state between them, only the store). Exactly one must win; the loser
+    observes PROCESSING and forwards nothing. Run many rounds across
+    thread interleavings: double dispatch here would mean double execution
+    in the fleet."""
+    import threading
+
+    backing = MemoryStore()
+
+    class ClientHandle:
+        """A distinct store *client* over the shared backing service —
+        models one daemon-side connection (the in-process analogue of a
+        second proxy/replay dispatcher holding its own socket)."""
+
+        def __init__(self, store):
+            self._s = store
+
+        def __getattr__(self, name):
+            return getattr(self._s, name)
+
+    j1 = RequestJournal(ClientHandle(backing))
+    j2 = RequestJournal(ClientHandle(backing))
+
+    rounds = 50
+    for n in range(rounds):
+        req = j1.store_request("a1", "POST", "/chat", body=b"x", request_id=f"race-{n}")
+        results = {}
+        barrier = threading.Barrier(2)
+
+        def racer(journal, who, replica):
+            barrier.wait()  # maximal contention: both hit the CAS together
+            results[who] = journal.acquire_processing(
+                "a1", req.id, replica_id=replica
+            )
+
+        t1 = threading.Thread(target=racer, args=(j1, "proxy", "eng-a"))
+        t2 = threading.Thread(target=racer, args=(j2, "replay", "eng-b"))
+        t1.start(); t2.start(); t1.join(); t2.join()
+
+        assert sorted(results.values()) == [False, True], results
+        entry = j1.get("a1", req.id)
+        assert entry.status == RequestStatus.PROCESSING
+        # the WINNER's replica attribution stuck (the loser wrote nothing)
+        winner_replica = "eng-a" if results["proxy"] else "eng-b"
+        assert entry.replica_id == winner_replica
+        # a third claim attempt (stale scan) also loses
+        assert j2.acquire_processing("a1", req.id, replica_id="eng-c") is False

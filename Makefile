@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all native test t1 test-native test-kernels bench overload spec paged fleet chaos server dryrun verify clean analyze analyze-native
+.PHONY: all native test t1 test-native test-kernels bench overload spec decodeloop paged fleet chaos server dryrun verify clean analyze analyze-native
 
 all: native
 
@@ -63,6 +63,13 @@ overload:
 spec:
 	JAX_PLATFORMS=cpu ATPU_SPEC_SMOKE=1 $(PY) scripts/bench_spec.py
 
+# fused decode-loop A/B in smoke mode (short passes, tiny model): decode ITL
+# fused on vs off at batch 1/4/max, the raw per-step floor the loop must sit
+# within 1.2x of, and host syncs per token (strictly fewer on the natural-EOS
+# workload); writes BENCH_decode_loop.json. Full run drops ATPU_DECODELOOP_SMOKE
+decodeloop:
+	JAX_PLATFORMS=cpu ATPU_DECODELOOP_SMOKE=1 $(PY) scripts/bench_decode_loop.py
+
 # paged KV arena A/B (tiny model): resident-session capacity at the
 # dense-equivalent HBM budget, warm-prefix TTFT zero-copy page mapping vs
 # the PR-2 compiled fork, and the steady-ITL regression guard on the
@@ -79,7 +86,8 @@ fleet:
 
 # chaos soak: live daemon + engine subprocesses through the seeded fault
 # schedule (store blips, SIGKILLs, slow dispatch, torn AOF, poisoned
-# prefill, replica-fleet failover/lease-flap/stale-routing phases);
+# prefill, SIGKILL-mid-fused-decode-loop resume, replica-fleet
+# failover/lease-flap/stale-routing phases);
 # asserts the durability invariants and writes BENCH_chaos.json.
 # Fixed seed -> reproducible schedule; full run drops ATPU_CHAOS_SMOKE
 chaos:

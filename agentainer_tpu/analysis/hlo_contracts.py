@@ -211,6 +211,7 @@ def engine_jit_fns(engine) -> dict[str, object]:
         "_restore_paged_fns",
         "_prefix_slice_fns",
         "_prefix_fork_fns",
+        "_fused_fns",
     ):
         cache = getattr(engine, attr, None)
         if isinstance(cache, dict):

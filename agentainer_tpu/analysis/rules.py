@@ -143,6 +143,7 @@ HOT_PATHS: dict[str, re.Pattern] = {
         r"^(_loop|_pump_queue|_admit_waiting|_has_dispatchable|_prefill_tick"
         r"|_decode_dispatch|_pick_chunk|_try_speculate|_spec_round|_spec_gamma"
         r"|_spec_draft|_drain_readbacks|_process_first|_process_chunk|_finish"
+        r"|_fused_dispatch|_process_fused"
         r"|_try_admit|_try_admit_paged|_try_admit_paged_locked|_bucket)$"
     ),
 }

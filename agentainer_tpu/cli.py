@@ -138,6 +138,12 @@ def cmd_deploy(args) -> None:
         option_overrides["prefix_cache"] = False
     if getattr(args, "no_deadlines", False):
         option_overrides["deadlines"] = False
+    if getattr(args, "fused_decode", False) or getattr(args, "no_fused_decode", False):
+        # fused on-device decode loop per deployment: --fused-decode opts
+        # in (one readback per loop), --no-fused-decode pins the per-chunk
+        # A/B baseline even when the fleet default (features.fused_decode)
+        # flips on
+        option_overrides["fused_decode"] = bool(getattr(args, "fused_decode", False))
     if option_overrides:
         if isinstance(model, str):
             engine, _, config = model.partition(":")
@@ -476,6 +482,22 @@ def build_parser() -> argparse.ArgumentParser:
         help="disable engine-side deadline enforcement for this agent "
         "(no fail-fast before prefill, no shed watermark; same as "
         "options.deadlines: false in a deployment YAML)",
+    )
+    fused_group = s.add_mutually_exclusive_group()
+    fused_group.add_argument(
+        "--fused-decode",
+        action="store_true",
+        help="run this agent's engine with the fused on-device decode loop "
+        "(multi-step lax.while_loop with in-loop sampling and per-lane "
+        "early exit; one host readback per loop instead of per chunk; "
+        "same as options.fused_decode: true in a deployment YAML)",
+    )
+    fused_group.add_argument(
+        "--no-fused-decode",
+        action="store_true",
+        help="pin this agent's engine to the per-chunk decode dispatch "
+        "(the A/B baseline) even when the fleet default "
+        "features.fused_decode is on",
     )
     s.add_argument("--health-endpoint", default="")
     s.add_argument("--health-interval", type=float, default=30.0)

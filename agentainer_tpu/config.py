@@ -56,6 +56,11 @@ class FeatureFlags:
     # admission-aware decode chunking and the cross-session prefix arena.
     adaptive_decode: bool = True
     prefix_cache: bool = True
+    # Default for engines' fused on-device decode loop (multi-step
+    # lax.while_loop with in-loop sampling, per-lane early exit, and one
+    # readback per loop). Off by default while the per-chunk dispatch
+    # remains the A/B baseline; per-deployment model options override.
+    fused_decode: bool = False
 
 
 @dataclass
@@ -371,6 +376,15 @@ def load_config(path: str | None = None) -> Config:
     )
     if "ATPU_PREFIX_CACHE" in env:
         cfg.features.prefix_cache = env["ATPU_PREFIX_CACHE"].lower() in (
+            "1",
+            "true",
+            "yes",
+        )
+    cfg.features.fused_decode = bool(
+        feats.get("fused_decode", cfg.features.fused_decode)
+    )
+    if "ATPU_FUSED_DECODE" in env:
+        cfg.features.fused_decode = env["ATPU_FUSED_DECODE"].lower() in (
             "1",
             "true",
             "yes",

@@ -53,7 +53,7 @@ jax.config.update("jax_threefry_partitionable", True)
 from .. import faults
 from ..models.configs import ModelConfig, get_config
 from ..models.llama import KVCache, PagedKVCache, forward, init_params
-from .sampling import NEG_INF, sample
+from .sampling import NEG_INF, sample, sample_step
 from .tokenizer import load_tokenizer
 
 PREFILL_BUCKETS = (32, 64, 128, 256, 512, 1024)
@@ -180,6 +180,11 @@ class GenRequest:
     # that need a stream of fixed length; tiny random-weight models hit EOS
     # whenever argmax lands on it)
     ignore_eos: bool = False
+    # nucleus/top-k filters, per request (0 / 1.0 = disabled): live in the
+    # device carry as per-lane arrays so one compiled sampler serves a
+    # batch mixing filtered and unfiltered lanes
+    top_k: int = 0
+    top_p: float = 1.0
     generated: list[int] = field(default_factory=list)
     # tokens sampled device-side so far (first token + dispatched decode
     # steps, including in-flight chunks): the remaining budget bounds how
@@ -343,6 +348,7 @@ class LLMEngine:
         paged_kv: bool = False,
         page_size: int = PAGE_SIZE_DEFAULT,
         kv_pages: int = 0,
+        fused_decode: bool = False,
     ):
         self.cfg = cfg
         self.tokenizer = tokenizer
@@ -365,6 +371,21 @@ class LLMEngine:
             print(
                 "[llm-engine] paged_kv disabled: not composable with "
                 f"sp={self.sp}/pp={self.pp} yet (dense arena retained)",
+                flush=True,
+            )
+        # Fused on-device decode loop: a per-ladder-rung compiled
+        # lax.while_loop runs up to `chunk` forward+sample+append steps
+        # entirely on device (per-lane EOS/budget masking, whole-batch
+        # early exit) with ONE readback at loop exit — the per-chunk
+        # host sync the ladder only shrank. fused_decode=False keeps the
+        # per-chunk scan dispatch exactly as-is (the A/B baseline). pp
+        # stages the forward across chips with host-side transfers per
+        # step, which cannot live inside a device loop — pp pins unfused.
+        self.fused_decode = bool(fused_decode) and self.pp == 1
+        if bool(fused_decode) and not self.fused_decode:
+            print(
+                "[llm-engine] fused_decode disabled: not composable with "
+                f"pp={self.pp} (per-chunk dispatch retained)",
                 flush=True,
             )
         self.page_size = max(8, int(page_size or PAGE_SIZE_DEFAULT))
@@ -594,18 +615,28 @@ class LLMEngine:
                 jnp.zeros((max_batch,), jnp.int32),
                 jnp.full((max_batch,), self.scratch_pos, jnp.int32),
                 jnp.zeros((max_batch,), jnp.float32),
+                jnp.zeros((max_batch,), jnp.int32),  # top_k (0 = disabled)
+                jnp.ones((max_batch,), jnp.float32),  # top_p (1 = disabled)
             )
 
         if self.mesh is not None:
             from jax.sharding import NamedSharding as _NS, PartitionSpec as _P
 
             repl = _NS(self.mesh, _P())
-            self._alloc_carry = jax.jit(_mk_carry, out_shardings=(repl, repl, repl))
+            self._alloc_carry = jax.jit(
+                _mk_carry, out_shardings=(repl, repl, repl, repl, repl)
+            )
         else:
             # committed (see the cache comment above): first-use and
             # steady-state signatures must match
             self._alloc_carry = lambda: jax.device_put(_mk_carry(), dev)
-        self._dtok, self._dpos, self._dtemps = self._alloc_carry()
+        (
+            self._dtok,
+            self._dpos,
+            self._dtemps,
+            self._dtopk,
+            self._dtopp,
+        ) = self._alloc_carry()
         # FIFO of lagged readbacks: ("first", slot, req, first_dev, t) and
         # ("chunk", [(slot, req, start_pos)...], toks_dev, t); staleness is
         # detected by `slot.request is not req` identity at processing time
@@ -773,6 +804,18 @@ class LLMEngine:
         self.spec_accepted = 0
         self.spec_rejected = 0
         self.spec_verify_hist: dict[int, int] = {}
+        # fused-loop observability (ISSUE 10): loops dispatched, on-device
+        # steps actually executed (early exits run fewer than the rung),
+        # loops that exited before the rung bound, exit-reason histogram,
+        # and host syncs — every host materialization of device decode
+        # output bumps host_syncs_total, so syncs/token quantifies the
+        # one-readback-per-loop claim against the per-chunk baseline.
+        self._fused_fns: dict[int, Any] = {}
+        self.fused_loops_total = 0
+        self.fused_steps_total = 0
+        self.fused_early_exits_total = 0
+        self.fused_exit_reason_hist: dict[str, int] = {}
+        self.host_syncs_total = 0
         self._n_chips = self.tp * self.ep * self.sp * self.pp
         self._chip = chip_spec((devices or jax.devices() or [None])[0])
         self._peak_flops = self._chip.bf16_flops * self._n_chips
@@ -898,6 +941,7 @@ class LLMEngine:
                 paged_kv=bool(options.get("paged_kv", False)),
                 page_size=int(options.get("page_size", PAGE_SIZE_DEFAULT) or PAGE_SIZE_DEFAULT),
                 kv_pages=int(options.get("kv_pages", 0) or 0),
+                fused_decode=bool(options.get("fused_decode", False)),
             )
             if not options.get("skip_warmup"):
                 engine.warmup()
@@ -1026,6 +1070,7 @@ class LLMEngine:
             paged_kv=bool(options.get("paged_kv", False)),
             page_size=int(options.get("page_size", PAGE_SIZE_DEFAULT) or PAGE_SIZE_DEFAULT),
             kv_pages=int(options.get("kv_pages", 0) or 0),
+            fused_decode=bool(options.get("fused_decode", False)),
         )
         # pay the decode/prefill compiles here (inside the loader thread, while
         # /health keeps answering) instead of on the first user request.
@@ -1113,7 +1158,7 @@ class LLMEngine:
             last = lax.dynamic_slice_in_dim(logits, n_real - 1, 1, axis=1)[0, 0]
             return last, cache
 
-        def decode_n(params, cache, tokens, positions, temps, keys, bt=None):
+        def decode_n(params, cache, tokens, positions, temps, topk, topp, keys, bt=None):
             """Kernel-looped decode: ``chunk`` autoregressive steps inside one
             compiled call (lax.scan), so the host↔device round trip is paid
             once per chunk, not once per token. The (token, position) carry
@@ -1132,7 +1177,10 @@ class LLMEngine:
             def step(carry, key):
                 tok, pos, cache = carry
                 logits, cache = run_forward(params, tok[:, None], pos[:, None], cache, bt)
-                nxt = sample(logits[:, 0], key, temperature=temps)
+                nxt = sample_step(
+                    logits[:, 0], key, temps, topk, topp,
+                    greedy_cond=self.mesh is None,
+                )
                 # clamp: parked (idle/finished) lanes decode forever at the
                 # scratch position — real lanes never reach it (admission
                 # budgets position + max_tokens below it)
@@ -1141,12 +1189,12 @@ class LLMEngine:
             (tok, pos, cache), toks = lax.scan(step, (tokens, positions, cache), keys)
             return toks, tok, pos, cache  # toks [chunk, B]
 
-        def decode_n_paged(params, cache, bt, tokens, positions, temps, keys):
+        def decode_n_paged(params, cache, bt, tokens, positions, temps, topk, topp, keys):
             # positional-arg adapter for the call-site splat (bt sits
             # between cache and the token state); the body is decode_n
-            return decode_n(params, cache, tokens, positions, temps, keys, bt)
+            return decode_n(params, cache, tokens, positions, temps, topk, topp, keys, bt)
 
-        def inject(tok, pos, temps, idx, first, position, temp):
+        def inject(tok, pos, temps, topk, topp, idx, first, position, temp, tk, tp_):
             """Point a slot's decode lane at its prefill result: lane `idx`
             continues from `first` (the sampled first token, still on
             device) at `position`. Idle/finished lanes are parked the same
@@ -1155,6 +1203,8 @@ class LLMEngine:
                 tok.at[idx].set(first),
                 pos.at[idx].set(position),
                 temps.at[idx].set(temp),
+                topk.at[idx].set(tk),
+                topp.at[idx].set(tp_),
             )
 
         if self.paged:
@@ -1163,11 +1213,125 @@ class LLMEngine:
         else:
             self._prefill = jax.jit(prefill, donate_argnums=(1,))
             self._decode_n = jax.jit(decode_n, donate_argnums=(1, 2, 3))
-        self._inject = jax.jit(inject, donate_argnums=(0, 1, 2))
+        self._inject = jax.jit(inject, donate_argnums=(0, 1, 2, 3, 4))
         # the verify ladder reuses the same forward (one prefill-shaped call
         # with t = k+1 per round); fns are built per bucket on demand and
         # warmed alongside the decode ladder
         self._run_forward = run_forward
+
+    def _fused_fn(self, chunk: int):
+        """Compiled fused decode loop for ladder rung ``chunk`` (ISSUE 10):
+        a ``lax.while_loop`` running up to ``chunk`` forward+sample+append
+        steps entirely on device, with per-lane EOS/budget masking and a
+        whole-batch early-exit predicate — so the only host↔device traffic
+        per loop is the dispatch and ONE packed readback at loop exit.
+
+        Carry: (i, tok, pos, cache, done, emitted[chunk,B], nemit[B],
+        reason[B]). ``done`` starts true for parked lanes (``~live``) and
+        budget-exhausted lanes; a live lane goes done when it samples EOS
+        (unless ``ign``) or its emitted count reaches its budget, at which
+        point it parks IN-LOOP at the scratch position — the finishing
+        token is recorded but never fed, so the host finishes it with
+        ``pending_last=True`` (the same carry-into-next-prompt semantics
+        the unfused boundary finish uses). The loop exits when every lane
+        is done or ``chunk`` steps ran. Sampling is ``sample_step`` over
+        the per-lane (temperature, top_k, top_p) carry with the SAME
+        per-dispatch key ladder the unfused scan consumes — greedy lanes
+        are bit-exact with ``fused_decode=False`` and temperature lanes
+        draw identically from identical keys.
+
+        Readback packing: one int32 [chunk+3, B] array — rows [0, chunk)
+        are emitted tokens (-1 past a lane's count), row ``chunk`` the
+        per-lane counts, row ``chunk+1`` the finish reasons (0 running /
+        1 EOS / 2 budget), row ``chunk+2`` the executed step count
+        (broadcast) — tokens, lengths, and finish reasons cross the host
+        boundary in exactly one transfer."""
+        fn = self._fused_fns.get(chunk)
+        if fn is not None:
+            return fn
+        run_forward = self._run_forward
+        scratch_static = self.max_seq - 1
+        eos_id = int(self.tokenizer.eos_id)
+
+        def fused_body(  # atp: hot
+            params, cache, tok, pos, temps, topk, topp, live, budgets, ign, keys, bt=None
+        ):
+            scratch = cache.k.shape[2] - 1 if bt is None else scratch_static
+            B = tok.shape[0]
+
+            def cond(c):
+                i, _, _, _, done, _, _, _ = c
+                return (i < chunk) & jnp.any(~done)
+
+            def body(c):
+                i, tok, pos, cache, done, emitted, nemit, reason = c
+                logits, cache = run_forward(
+                    params, tok[:, None], pos[:, None], cache, bt
+                )
+                nxt = sample_step(
+                    logits[:, 0], keys[i], temps, topk, topp,
+                    greedy_cond=self.mesh is None,
+                )
+                rec = ~done  # lanes still recording output this step
+                emitted = lax.dynamic_update_index_in_dim(
+                    emitted, jnp.where(rec, nxt, -1), i, axis=0
+                )
+                nemit = nemit + rec.astype(jnp.int32)
+                hit_eos = rec & (nxt == eos_id) & (~ign)
+                hit_max = rec & (nemit >= budgets)
+                reason = jnp.where((reason == 0) & hit_eos, 1, reason)
+                reason = jnp.where((reason == 0) & hit_max, 2, reason)
+                done = done | hit_eos | hit_max
+                # a finishing lane parks IN-LOOP: its sampled token is
+                # recorded but never fed, and its position pins at scratch
+                # (the idle-lane write target) — unlike the unfused chunk,
+                # which keeps overshooting real positions until the host
+                # notices. Live lanes advance exactly like the unfused scan.
+                tok = jnp.where(done, tok, nxt)
+                pos = jnp.where(
+                    done,
+                    jnp.full_like(pos, scratch),
+                    jnp.minimum(pos + 1, scratch),
+                )
+                return (i + 1, tok, pos, cache, done, emitted, nemit, reason)
+
+            init = (
+                jnp.int32(0),
+                tok,
+                pos,
+                cache,
+                ~live | (budgets <= 0),
+                jnp.full((chunk, B), -1, jnp.int32),
+                jnp.zeros((B,), jnp.int32),
+                jnp.zeros((B,), jnp.int32),
+            )
+            i, tok, pos, cache, done, emitted, nemit, reason = lax.while_loop(
+                cond, body, init
+            )
+            packed = jnp.concatenate(
+                [
+                    emitted,
+                    nemit[None, :],
+                    reason[None, :],
+                    jnp.broadcast_to(i, (1, B)).astype(jnp.int32),
+                ],
+                axis=0,
+            )
+            return packed, tok, pos, cache
+
+        if self.paged:
+
+            def fused_paged(
+                params, cache, bt, tok, pos, temps, topk, topp, live, budgets, ign, keys
+            ):
+                return fused_body(
+                    params, cache, tok, pos, temps, topk, topp, live, budgets, ign, keys, bt
+                )
+
+            fn = self._fused_fns[chunk] = jax.jit(fused_paged, donate_argnums=(1, 3, 4))
+        else:
+            fn = self._fused_fns[chunk] = jax.jit(fused_body, donate_argnums=(1, 2, 3))
+        return fn
 
     def warmup(self) -> None:
         """Pre-compile every serve-path signature BY SERVING: one synthetic
@@ -1311,6 +1475,8 @@ class LLMEngine:
                     self._dtok,
                     self._dpos,
                     self._dtemps,
+                    self._dtopk,
+                    self._dtopp,
                     jnp.zeros((self.max_batch, b), jnp.int32),
                     jnp.zeros((self.max_batch,), jnp.int32),
                     key,
@@ -1326,6 +1492,11 @@ class LLMEngine:
         self.first_readback_ms_recent.clear()
         self.decode_chunk_hist = {}
         self.decode_chunks_shrunk = 0
+        self.fused_loops_total = 0
+        self.fused_steps_total = 0
+        self.fused_early_exits_total = 0
+        self.fused_exit_reason_hist = {}
+        self.host_syncs_total = 0
         self._prefix_entries.clear()
         self._prefix_bytes = 0
         self.prefix_hits = 0
@@ -1377,6 +1548,8 @@ class LLMEngine:
         session: str = "",
         deadline_at: float | None = None,
         ignore_eos: bool = False,
+        top_k: int = 0,
+        top_p: float = 1.0,
     ) -> dict:
         if request_id:
             with self._lock:
@@ -1405,6 +1578,8 @@ class LLMEngine:
             future=loop.create_future(),
             deadline_at=deadline_at if self.deadlines else None,
             ignore_eos=ignore_eos,
+            top_k=max(0, int(top_k)),
+            top_p=min(1.0, max(0.0, float(top_p))) if top_p is not None else 1.0,
         )
         self._queue.put(req)
         result = await req.future
@@ -2312,6 +2487,25 @@ class LLMEngine:
                 str(k): v for k, v in sorted(self.spec_verify_hist.copy().items())
             },
             "spec_slot_acceptance": [round(s.spec_ema, 3) for s in self.slots],
+            # fused on-device decode loop: loops dispatched, device steps
+            # executed (early exits run fewer than the rung), early-exit
+            # count, exit-reason histogram, and the host-sync economics —
+            # host_syncs_per_token is THE fused-vs-unfused readback claim
+            # as a gauge (one sync per loop exit vs one per chunk, plus
+            # the shared first-token and spec-round syncs in both modes)
+            "fused_decode": self.fused_decode,
+            "fused_loops_total": self.fused_loops_total,
+            "fused_steps_total": self.fused_steps_total,
+            "fused_early_exits_total": self.fused_early_exits_total,
+            "fused_exit_reason_hist": dict(
+                sorted(self.fused_exit_reason_hist.copy().items())
+            ),
+            "host_syncs_total": self.host_syncs_total,
+            "host_syncs_per_token": (
+                round(self.host_syncs_total / self.tokens_generated, 4)
+                if self.tokens_generated
+                else None
+            ),
             "worker_errors": self.worker_errors,
             "last_worker_error": self.last_worker_error or None,
             "cache_resets": self.cache_resets,
@@ -2538,7 +2732,10 @@ class LLMEngine:
                     # decode-chunk path — gamma collapse makes low-match
                     # traffic live here permanently
                     if not self._try_speculate():
-                        self._decode_dispatch()
+                        if self.fused_decode:
+                            self._fused_dispatch()
+                        else:
+                            self._decode_dispatch()
                 else:
                     self._last_decode_end = None  # idle gap isn't ITL
                 # drain landed readbacks; block on the oldest when the
@@ -2681,6 +2878,36 @@ class LLMEngine:
             self._fail_item(req, err)
             self._abandon_slot(slot)
 
+    def _inject_lane(
+        self, idx: int, first, position: int, temp: float, top_k: int, top_p: float
+    ) -> None:
+        """Jitted single-lane scatter into the 5-array decode carry (token,
+        position, temperature, top_k, top_p)."""
+        (
+            self._dtok,
+            self._dpos,
+            self._dtemps,
+            self._dtopk,
+            self._dtopp,
+        ) = self._inject(
+            self._dtok,
+            self._dpos,
+            self._dtemps,
+            self._dtopk,
+            self._dtopp,
+            jnp.int32(idx),
+            first,
+            jnp.int32(position),
+            jnp.float32(temp),
+            jnp.int32(top_k),
+            jnp.float32(top_p),
+        )
+
+    def _park_lane(self, idx: int) -> None:
+        """Point a lane at the scratch position with neutral sampling state
+        (idle/finished/aborted lanes all park identically)."""
+        self._inject_lane(idx, jnp.int32(0), self.scratch_pos, 0.0, 0, 1.0)
+
     def _abandon_slot(self, slot: Slot, rollback: bool = False) -> None:
         """Free a slot whose request was aborted mid-flight: park its decode
         lane (chunks already dispatched keep stepping it until the park
@@ -2691,15 +2918,7 @@ class LLMEngine:
         if slot.decoding:
             slot.decoding = False
             slot.dev_position = self.scratch_pos
-            self._dtok, self._dpos, self._dtemps = self._inject(
-                self._dtok,
-                self._dpos,
-                self._dtemps,
-                jnp.int32(slot.idx),
-                jnp.int32(0),
-                jnp.int32(self.scratch_pos),
-                jnp.float32(0.0),
-            )
+            self._park_lane(slot.idx)
         self._reset_slot(slot, rollback=rollback)
 
     def _has_dispatchable(self) -> bool:
@@ -2803,14 +3022,20 @@ class LLMEngine:
                     for i in range(self.max_batch):
                         self._bt[i, :] = self._scratch_page(i)
         carry_lost = False
-        for arr in (self._dtok, self._dpos, self._dtemps):
+        for arr in (self._dtok, self._dpos, self._dtemps, self._dtopk, self._dtopp):
             try:
                 if arr.is_deleted():
                     carry_lost = True
             except Exception:
                 carry_lost = True
         if carry_lost:
-            self._dtok, self._dpos, self._dtemps = self._alloc_carry()
+            (
+                self._dtok,
+                self._dpos,
+                self._dtemps,
+                self._dtopk,
+                self._dtopp,
+            ) = self._alloc_carry()
             # fresh carry parks every lane at scratch: decoding requests
             # lost their device position and cannot continue
             for slot in self.slots:
@@ -3267,18 +3492,24 @@ class LLMEngine:
         # the final chunk's padding lands strictly above slot.position)
         self._prefix_register(slot)
         self._rng, key = jax.random.split(self._rng)
-        first = sample(last_logits[None], key, temperature=jnp.asarray([req.temperature]))
+        first = sample_step(
+            last_logits[None],
+            key,
+            jnp.asarray([req.temperature], jnp.float32),
+            jnp.asarray([req.top_k], jnp.int32),
+            jnp.asarray([req.top_p], jnp.float32),
+            greedy_cond=self.mesh is None,
+        )
         # point the slot's decode lane at this prompt's continuation WITHOUT
         # waiting for the sampled token to reach the host — decode chunks
         # chain from it on device; the value lands via the readback queue
-        self._dtok, self._dpos, self._dtemps = self._inject(
-            self._dtok,
-            self._dpos,
-            self._dtemps,
-            jnp.int32(slot.idx),
+        self._inject_lane(
+            slot.idx,
             first[0].astype(jnp.int32),
-            jnp.int32(slot.position),
-            jnp.float32(req.temperature),
+            slot.position,
+            req.temperature,
+            req.top_k,
+            req.top_p,
         )
         slot.dev_position = slot.position
         slot.decoding = True
@@ -3312,15 +3543,7 @@ class LLMEngine:
             # this injection lands in dispatch order
             slot.decoding = False
             slot.dev_position = self.scratch_pos
-            self._dtok, self._dpos, self._dtemps = self._inject(
-                self._dtok,
-                self._dpos,
-                self._dtemps,
-                jnp.int32(slot.idx),
-                jnp.int32(0),
-                jnp.int32(self.scratch_pos),
-                jnp.float32(0.0),
-            )
+            self._park_lane(slot.idx)
         breakdown = None
         if req.ttft_ms and req.prefill_started_at and req.prefill_done_at:
             breakdown = {
@@ -3415,6 +3638,8 @@ class LLMEngine:
             self._dtok,
             self._dpos,
             self._dtemps,
+            self._dtopk,
+            self._dtopp,
             keys,
         )
         for s, r, _ in snapshot:
@@ -3434,7 +3659,94 @@ class LLMEngine:
             pass
         self._readbacks.append(("chunk", snapshot, toks, time.monotonic()))
 
-    def _pick_chunk(self, needed: int) -> int:
+    def _fused_dispatch(self) -> None:  # atp: hot
+        """Dispatch one fused on-device decode loop (fused_decode=True's
+        replacement for _decode_dispatch): same snapshot/ladder/paged
+        pre-allocation discipline, but the compiled call is the
+        per-ladder-rung while_loop (_fused_fn) that masks finished lanes
+        and early-exits on device — the readback queued here is the loop's
+        single packed (tokens, lengths, reasons, steps) transfer. The loop
+        bound IS the ladder rung, so the admission contention story carries
+        over: contention shrinks the loop, newcomers' prefill still
+        preempts at rung boundaries. Speculation composes between fused
+        loops — _try_speculate runs its draft-verify bracket and falls
+        through here when no lane drafts."""
+        snapshot = [
+            (s, s.request, s.dev_position)
+            for s in self.slots
+            if s.decoding and s.request is not None
+        ]
+        if not snapshot:
+            return
+        needed = max(r.max_tokens - r.dispatched for _, r, _ in snapshot)
+        if needed <= 0:
+            return
+        # failpoint: same batch-wide seam as engine.decode_step, but its
+        # own catalog name — chaos schedules can cut (or delay, for the
+        # SIGKILL-mid-loop soak phase) exactly the fused path
+        if any(r.id for _, r, _ in snapshot):
+            faults.fire("engine.fused_decode")
+        # tail_shrink=False: budget tails stay on the top rung — the
+        # in-loop masks + early exit absorb the overshoot for free, one
+        # readback instead of the shrinking ladder's one-per-rung
+        chunk = self._pick_chunk(needed, tail_shrink=False)
+        if self.paged:
+            kept = []
+            for s, r, p in snapshot:
+                try:
+                    self._ensure_lane_pages(
+                        s, min(p + chunk - 1, self.max_seq - 2), serving=bool(r.id)
+                    )
+                    kept.append((s, r, p))
+                except EngineOverloaded as e:
+                    self._fail_item(r, e)
+                    self._abandon_slot(s, rollback=True)
+            snapshot = kept
+            if not snapshot:
+                return
+        self._rng, key = jax.random.split(self._rng)
+        keys = jax.random.split(key, chunk)
+        live = np.zeros((self.max_batch,), dtype=bool)
+        budgets = np.zeros((self.max_batch,), dtype=np.int32)
+        ign = np.zeros((self.max_batch,), dtype=bool)
+        for s, r, _ in snapshot:
+            live[s.idx] = True
+            # chunk+1 cap: a lane with budget beyond this loop must NOT
+            # trip the in-loop budget check at the boundary — boundary
+            # finishes belong to the host scan, exactly like unfused
+            budgets[s.idx] = min(r.max_tokens - r.dispatched, chunk + 1)
+            ign[s.idx] = bool(r.ignore_eos)
+        packed, self._dtok, self._dpos, self.cache = self._fused_fn(chunk)(
+            self.params,
+            self.cache,
+            *self._bt_arg(),
+            self._dtok,
+            self._dpos,
+            self._dtemps,
+            self._dtopk,
+            self._dtopp,
+            jnp.asarray(live),
+            jnp.asarray(budgets),
+            jnp.asarray(ign),
+            keys,
+        )
+        for s, r, _ in snapshot:
+            # exact for unfinished lanes (they force the loop to run all
+            # `chunk` steps); finished lanes park at scratch on device and
+            # their host state is settled at processing (_process_fused)
+            s.dev_position += chunk
+            r.dispatched += chunk
+        self.fused_loops_total += 1
+        self.decode_chunk_hist[chunk] = self.decode_chunk_hist.get(chunk, 0) + 1
+        self.decode_steps += 1
+        self._occupancy_sum += len(snapshot) / self.max_batch
+        try:
+            packed.copy_to_host_async()
+        except Exception:
+            pass
+        self._readbacks.append(("fused", snapshot, packed, chunk, time.monotonic()))
+
+    def _pick_chunk(self, needed: int, tail_shrink: bool = True) -> int:
         """Adaptive decode-chunk policy (the admission-aware half of the
         scheduler). Contention — a queued/waiting request or a mid-prefill
         prompt — shrinks to the smallest compiled bucket, so the worker gets
@@ -3443,7 +3755,15 @@ class LLMEngine:
         TTFT). Otherwise: the smallest bucket covering the remaining token
         budget, so sequence tails don't dispatch overshoot garbage. Steady
         state with budget to burn returns the full chunk — ITL and HBM
-        efficiency are untouched when nobody is waiting."""
+        efficiency are untouched when nobody is waiting.
+
+        ``tail_shrink=False`` is the fused dispatcher's mode: its in-loop
+        budget masks park finishing lanes on device and the whole-batch
+        early exit ends the loop the step everyone is done, so a budget
+        tail costs nothing extra on the top rung — and riding the top rung
+        pays ONE readback where the shrinking ladder pays one per rung.
+        The contention downshift still applies (a loop over live lanes
+        can't early-exit on a waiter's behalf)."""
         if not self.adaptive_decode:
             return self.decode_chunk
         contended = any(s.request is not None and s.pending_prompt for s in self.slots)
@@ -3457,6 +3777,8 @@ class LLMEngine:
         if contended and self._decode_ladder[0] < self.decode_chunk:
             self.decode_chunks_shrunk += 1
             return self._decode_ladder[0]
+        if not tail_shrink:
+            return self.decode_chunk
         target = max(1, min(needed, self.decode_chunk))
         for c in self._decode_ladder:
             if c >= target:
@@ -3491,7 +3813,9 @@ class LLMEngine:
         if fn is None:
             run_forward = self._run_forward
 
-            def verify_body(params, cache, tok, pos, temps, drafts, dlen, key, bt=None):
+            def verify_body(
+                params, cache, tok, pos, temps, topk, topp, drafts, dlen, key, bt=None
+            ):
                 # the paged pool's page axis says nothing about the logical
                 # arena length — scratch comes from the engine statics there
                 scratch = cache.k.shape[2] - 1 if bt is None else self.max_seq - 1
@@ -3534,7 +3858,15 @@ class LLMEngine:
                 row_a = jnp.where(
                     (vocab == draft_a[:, None]) & rejected[:, None], NEG_INF, row_a
                 )
-                bonus = sample(row_a, k_bonus, temperature=temps).astype(jnp.int32)
+                # the bonus/correction token goes through the same per-lane
+                # filtered sampler as plain decode (lanes with active
+                # filters never draft — _spec_gamma gates them to 0 — so
+                # the rejection-sampling acceptance above stays valid
+                # against the unfiltered target)
+                bonus = sample_step(
+                    row_a, k_bonus, temps, topk, topp,
+                    greedy_cond=self.mesh is None,
+                ).astype(jnp.int32)
                 m = jnp.arange(K + 1, dtype=jnp.int32)[None, :]
                 shifted = jnp.concatenate(
                     [toks[:, 1:], jnp.zeros_like(tok)[:, None]], axis=1
@@ -3548,9 +3880,11 @@ class LLMEngine:
 
             if self.paged:
 
-                def verify_paged(params, cache, bt, tok, pos, temps, drafts, dlen, key):
+                def verify_paged(
+                    params, cache, bt, tok, pos, temps, topk, topp, drafts, dlen, key
+                ):
                     return verify_body(
-                        params, cache, tok, pos, temps, drafts, dlen, key, bt
+                        params, cache, tok, pos, temps, topk, topp, drafts, dlen, key, bt
                     )
 
                 fn = self._verify_fns[K] = jax.jit(
@@ -3558,8 +3892,12 @@ class LLMEngine:
                 )
             else:
 
-                def verify(params, cache, tok, pos, temps, drafts, dlen, key):
-                    return verify_body(params, cache, tok, pos, temps, drafts, dlen, key)
+                def verify(
+                    params, cache, tok, pos, temps, topk, topp, drafts, dlen, key
+                ):
+                    return verify_body(
+                        params, cache, tok, pos, temps, topk, topp, drafts, dlen, key
+                    )
 
                 fn = self._verify_fns[K] = jax.jit(verify, donate_argnums=(1, 2, 3))
         return fn
@@ -3573,6 +3911,14 @@ class LLMEngine:
         shift re-opens speculation without taxing the steady state."""
         req = slot.request
         if req is None or not req.generated:
+            return 0
+        if req.temperature > 0.0 and (req.top_k > 0 or req.top_p < 1.0):
+            # point-mass rejection sampling verifies against the UNFILTERED
+            # target distribution; a filtered temperature lane would accept
+            # drafts the filtered sampler could never emit. Such lanes ride
+            # verify rounds draft-free (dlen=0 — the bonus token still goes
+            # through their filters). Greedy lanes draft regardless: argmax
+            # is invariant under top-k/top-p masking.
             return 0
         cap = min(
             self.spec_gamma_max,
@@ -3705,6 +4051,8 @@ class LLMEngine:
                 self._dtok,
                 self._dpos,
                 self._dtemps,
+                self._dtopk,
+                self._dtopp,
                 jnp.asarray(drafts),
                 jnp.asarray(dlen),
                 key,
@@ -3712,6 +4060,7 @@ class LLMEngine:
         )
         emitted = np.asarray(emitted_dev)  # sync readback: spec rounds don't pipeline
         count = np.asarray(count_dev)
+        self.host_syncs_total += 1
         end = time.monotonic()
         self.spec_rounds += 1
         self.spec_verify_hist[K] = self.spec_verify_hist.get(K, 0) + 1
@@ -3821,6 +4170,8 @@ class LLMEngine:
             self._readbacks.popleft()
             if entry[0] == "first":
                 self._process_first(entry)
+            elif entry[0] == "fused":
+                self._process_fused(entry)
             else:
                 self._process_chunk(entry)
             block = False
@@ -3876,6 +4227,7 @@ class LLMEngine:
         if slot.request is not req:
             return  # request failed/superseded while the copy was in flight
         first_id = int(np.asarray(first)[0])
+        self.host_syncs_total += 1
         now = time.monotonic()
         req.ttft_ms = 1000 * (now - req.submitted_at)
         self.ttft_ms_recent.append(req.ttft_ms)
@@ -3897,6 +4249,7 @@ class LLMEngine:
     def _process_chunk(self, entry) -> None:
         _, snapshot, toks_dev, _ = entry
         toks = np.asarray(toks_dev)  # [chunk, B]
+        self.host_syncs_total += 1
         chunk = toks.shape[0]
         # ITL = wall time between consecutive chunk completions (including
         # any interleaved prefill chunk) per generated token
@@ -3938,6 +4291,84 @@ class LLMEngine:
                 self._finish(slot, pending_last=True)
             else:
                 slot.position = start + chunk
+
+    def _process_fused(self, entry) -> None:  # atp: hot
+        """Process one fused loop's packed readback — the loop's ONE host
+        sync. The host rescans the emitted tokens against its own remaining
+        budget and EOS policy (the same scan _process_chunk runs), so stale
+        lanes and mid-flight aborts resolve identically in both modes; the
+        device's finish reasons are trusted only for device-state
+        bookkeeping. A finished lane parked in-loop, so its finishing token
+        was never fed: ``pending_last=True`` for every fused finish, and
+        slot.position lands at start+used (no overshoot feed to roll back)."""
+        _, snapshot, packed_dev, chunk, _ = entry
+        packed = np.asarray(packed_dev)  # [chunk+3, B]: tokens/counts/reasons/steps
+        self.host_syncs_total += 1
+        steps = int(packed[chunk + 2, 0])
+        self.fused_steps_total += steps
+        if steps < chunk:
+            self.fused_early_exits_total += 1
+            self.fused_exit_reason_hist["early_all_finished"] = (
+                self.fused_exit_reason_hist.get("early_all_finished", 0) + 1
+            )
+        else:
+            self.fused_exit_reason_hist["limit"] = (
+                self.fused_exit_reason_hist.get("limit", 0) + 1
+            )
+        end = time.monotonic()
+        if self._last_decode_end is not None and steps:
+            self.itl_ms_recent.append(1000 * (end - self._last_decode_end) / steps)
+        self._last_decode_end = end
+        # HBM accounting happens here (not at dispatch) because the
+        # executed step count is data-dependent: weights stream once per
+        # while_loop iteration actually run, plus each lane's KV prefix
+        self.hbm_bytes_read += steps * self.param_hbm_bytes + sum(
+            steps * (p + steps // 2) * self._kv_bytes_per_pos for _, _, p in snapshot
+        )
+        eos = self.tokenizer.eos_id
+        for slot, req, start in snapshot:
+            if slot.request is not req:
+                continue  # finished/aborted in an earlier (lagged) entry
+            if not req.generated:
+                continue  # FIFO order puts the "first" entry before any loop
+            cnt = int(packed[chunk, slot.idx])
+            reason = int(packed[chunk + 1, slot.idx])
+            outs = packed[:, slot.idx][:cnt]
+            remaining = req.max_tokens - len(req.generated)
+            used = 0
+            hit_eos = False
+            for j in range(min(cnt, remaining)):
+                used += 1
+                if not req.ignore_eos and int(outs[j]) == eos:
+                    hit_eos = True
+                    break
+            req.generated.extend(int(t) for t in outs[:used])
+            self.tokens_generated += used
+            self.flops_done += used * self.cfg.flops_per_token(start + used // 2)
+            finished = hit_eos or len(req.generated) >= req.max_tokens
+            if finished:
+                # the loop never fed the finishing token (in-loop park):
+                # it is absent from KV — carried into the next turn's
+                # prompt, the same pending_last finish a boundary EOS takes
+                slot.position = start + used
+                self._finish(slot, pending_last=True)
+            elif reason != 0:
+                # defensive: the device parked a lane the host scan wants
+                # to keep (cannot happen while budgets mirror remaining —
+                # but a parked live lane would decode garbage at scratch
+                # forever, so re-point it at its last token explicitly)
+                slot.position = start + used
+                slot.dev_position = slot.position
+                self._inject_lane(
+                    slot.idx,
+                    jnp.int32(int(outs[used - 1])),
+                    slot.position,
+                    req.temperature,
+                    req.top_k,
+                    req.top_p,
+                )
+            else:
+                slot.position = start + used
 
 
 def _resolve(future: asyncio.Future, result: dict) -> None:

@@ -291,6 +291,7 @@ class LLMServeApp:
             ("adaptive_decode", "ATPU_ADAPTIVE_DECODE"),
             ("prefix_cache", "ATPU_PREFIX_CACHE"),
             ("deadlines", "ATPU_DEADLINES"),
+            ("fused_decode", "ATPU_FUSED_DECODE"),
         ):
             raw = os.environ.get(env_name)
             if raw is not None and flag not in opts:
@@ -904,6 +905,8 @@ class LLMServeApp:
                 prompt=str(body.get("prompt", "")),
                 max_tokens=int(body.get("max_tokens", 64)),
                 temperature=float(body.get("temperature", 0.0)),
+                top_k=int(body.get("top_k", 0)),
+                top_p=float(body.get("top_p", 1.0)),
                 request_id=request.headers.get("X-Agentainer-Request-ID", ""),
                 **dl_kw,
             )

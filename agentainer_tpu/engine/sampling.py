@@ -56,3 +56,72 @@ def sample(
     scaled = filtered / jnp.maximum(temperature, 1e-6)[:, None]
     sampled = jax.random.categorical(key, scaled, axis=-1)
     return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+def sample_step(
+    logits: jnp.ndarray,  # [B, V] float32
+    key: jax.Array,
+    temperature: jnp.ndarray,  # [B] float32, 0 → greedy
+    top_k: jnp.ndarray,  # [B] int32, <= 0 → disabled
+    top_p: jnp.ndarray,  # [B] float32, >= 1 → disabled
+    *,
+    greedy_cond: bool = True,
+) -> jnp.ndarray:
+    """The fused-loop sampler: every filter is a per-lane ARRAY so a single
+    compiled while_loop body serves a batch mixing greedy, temperature,
+    top-k, and top-p lanes.
+
+    Bit-exact with :func:`sample`: when a lane's filter is disabled the
+    ``where`` keeps the original logit row untouched (not a recomputed
+    copy), and when a filter is active the threshold math is the same
+    sort-based mask — so `sample(logits, key, t, k, p)` and
+    `sample_step(logits, key, [t]*B, [k]*B, [p]*B)` draw identical tokens
+    from identical keys.
+
+    The all-greedy batch (the dominant agentic case, and every batch whose
+    sampled lanes are parked) takes a ``lax.cond`` fast path: per-lane
+    filters as ARRAYS mean the sorts/softmax/threefry below can't be
+    constant-folded away like scalar ``sample``'s can, and paying two
+    [B, V] sorts plus a categorical draw per decode step to then discard
+    them lane-by-lane roughly doubles the per-step wall. Greedy ignores
+    the filters anyway (argmax is invariant under top-k/top-p masks), so
+    the branch is exact, not approximate.
+
+    ``greedy_cond=False`` (static) drops the ``lax.cond`` and always runs
+    the where-merged pipeline — bit-identical output, just no fast path.
+    MESHED engines must pass it: this jaxlib's XLA:CPU partitioner
+    segfaults compiling a batch-wide conditional over sharded operands
+    (pp/sp/tp warmup died inside the cond), and on a real mesh the sort
+    pipeline is cheap relative to the sharded forward anyway.
+    """
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def _sampled(_):
+        # top-k as a mask: k_eff clamps into [1, V] (clamp-to-vocab
+        # semantics of sample()); kth = the k-th largest logit =
+        # ascending-sorted[V - k].
+        asc = jnp.sort(logits, axis=-1)
+        k_eff = jnp.clip(top_k.astype(jnp.int32), 1, V)
+        kth = jnp.take_along_axis(asc, (V - k_eff)[:, None], axis=-1)  # [B, 1]
+        k_on = (top_k > 0)[:, None]
+        filtered = jnp.where(k_on & (logits < kth), NEG_INF, logits)
+
+        # top-p on the (possibly top-k-filtered) row, gated per lane
+        sorted_logits = jnp.sort(filtered, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        cutoff_idx = jnp.sum(cum < top_p[:, None], axis=-1)  # [B]
+        cutoff_logit = jnp.take_along_axis(
+            sorted_logits, cutoff_idx[:, None], axis=-1
+        )
+        p_on = (top_p < 1.0)[:, None]
+        filtered = jnp.where(p_on & (filtered < cutoff_logit), NEG_INF, filtered)
+
+        scaled = filtered / jnp.maximum(temperature, 1e-6)[:, None]
+        sampled = jax.random.categorical(key, scaled, axis=-1)
+        return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)
+
+    if not greedy_cond:
+        return _sampled(None)
+    return jax.lax.cond(jnp.all(temperature <= 0.0), lambda _: greedy, _sampled, None)

@@ -121,6 +121,7 @@ CATALOG: frozenset[str] = frozenset(
         "engine.submit",
         "engine.prefill",
         "engine.decode_step",
+        "engine.fused_decode",
         "engine.snapshot",
         "engine.page_alloc",
         "watcher.respawn",

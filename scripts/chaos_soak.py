@@ -403,6 +403,152 @@ class Soak:
             return False
         return True
 
+    async def phase_fused_resume(self, fused_id: str) -> bool:
+        """SIGKILL mid-FUSED-loop: the same token-identical contract as
+        phase_llm_resume, but on a ``fused_decode=true`` engine whose armed
+        ``engine.fused_decode`` delay (150 ms per loop dispatch) stretches
+        the victim's in-flight turn so the kill lands INSIDE a compiled
+        while_loop window. The loop's single packed readback dies with the
+        process — nothing of the partial loop was ever on the host — and
+        the journaled turn must be rebuilt on the respawned engine from
+        the KV snapshot, token-identical to the control's."""
+
+        async def turn(session: str, message: str, n: int = 32):
+            resp = await self.client.post(
+                f"/agent/{fused_id}/chat",
+                data=json.dumps(
+                    {
+                        "message": message,
+                        "session": session,
+                        "max_tokens": n,
+                        "ignore_eos": True,
+                    }
+                ),
+            )
+            doc = await resp.json()
+            rid = resp.headers.get("X-Agentainer-Request-ID", "")
+            return resp.status, doc.get("response", ""), rid
+
+        engine_id = self.services.manager.get_agent(fused_id).engine_id
+        t_warm = time.monotonic()
+        while time.monotonic() - t_warm < 90.0:
+            stats = self.services.backend.stats(engine_id) or {}
+            if stats.get("model_loaded"):
+                break
+            await asyncio.sleep(0.5)
+        else:
+            self.violations.append("fused_resume: engine never loaded")
+            return False
+        if stats.get("fused_decode") is not True:
+            self.violations.append("fused_resume: agent is not serving fused decode")
+            return False
+
+        status, _, _ = await turn("fuctl", "alpha alpha alpha")
+        assert status == 200, f"fused ctl turn1 got {status}"
+        status, ctl_t2, _ = await turn("fuctl", "beta beta")
+        assert status == 200, f"fused ctl turn2 got {status}"
+        status, ctl_t3, _ = await turn("fuctl", "gamma", n=12)
+        assert status == 200, f"fused ctl turn3 got {status}"
+        status, _, _ = await turn("fuvic", "alpha alpha alpha")
+        assert status == 200, f"fused vic turn1 got {status}"
+        # resume is conditional on a durable snapshot (same contract as
+        # phase_llm_resume — never landing is itself a violation)
+        kv_key = f"agent:{fused_id}:kvcache:fuvic"
+        t_snap = time.monotonic()
+        while self.services.store.get(kv_key) is None:
+            if time.monotonic() - t_snap > 45.0:
+                self.violations.append("fused_resume: KV snapshot never landed")
+                return False
+            await asyncio.sleep(0.25)
+
+        # fire turn2 and kill MID-LOOP: the armed fused-dispatch delay
+        # makes each while_loop window take >= 150 ms, so 0.25 s into the
+        # 32-token turn the process is past prefill and inside (or between)
+        # fused loops whose results the host has never seen
+        t2_task = asyncio.ensure_future(turn("fuvic", "beta beta"))
+        await asyncio.sleep(0.25)
+        t_kill = time.monotonic()
+        self.services.backend.kill_engine_hard(engine_id)
+        status, live_t2, rid = await t2_task
+        if status == 200:
+            # kill landed after the turn completed — still a valid A/B
+            if live_t2 != ctl_t2:
+                self.violations.append(
+                    f"fused_resume: live turn2 diverged: {live_t2!r} != {ctl_t2!r}"
+                )
+                return False
+        else:
+            if not rid:
+                self.violations.append(
+                    f"fused_resume: turn2 got {status} with no request id"
+                )
+                return False
+            # the acked-by-journal turn replays onto the respawned engine
+            # and must settle COMPLETED with the token-identical text
+            deadline = time.monotonic() + RECOVERY_CAP_S
+            req = None
+            while time.monotonic() < deadline:
+                req = self.services.journal.get(fused_id, rid)
+                if req is not None and req.status == "completed":
+                    break
+                await asyncio.sleep(0.25)
+            if req is None or req.status != "completed":
+                self.violations.append(
+                    "fused_resume: mid-loop turn never settled "
+                    f"({None if req is None else req.status})"
+                )
+                return False
+            import base64 as _b64
+
+            body = _b64.b64decode((req.response or {}).get("body_b64", "") or "")
+            try:
+                archived = json.loads(body).get("response", "")
+            except Exception:
+                archived = ""
+            if archived != ctl_t2:
+                self.violations.append(
+                    f"fused_resume: archived turn2 diverged: "
+                    f"{archived!r} != {ctl_t2!r}"
+                )
+                return False
+        # recovery probes on a THROWAWAY session (a 502'd probe pointed at
+        # fuvic would journal-replay an extra turn and desync the context)
+        t0 = time.monotonic()
+        recovered = False
+        while time.monotonic() - t0 < RECOVERY_CAP_S:
+            s, _, _ = await turn("fuprobe", "ping", n=4)
+            if s == 200:
+                recovered = True
+                break
+            await asyncio.sleep(0.5)
+        self.mttr["fused_sigkill"] = (
+            round(time.monotonic() - t_kill, 3) if recovered else -1.0
+        )
+        if not recovered:
+            self.violations.append("fused_resume: engine never served again")
+            return False
+        # the next LIVE victim turn continues the spliced session exactly
+        status, vic_t3, _ = await turn("fuvic", "gamma", n=12)
+        if status != 200:
+            self.violations.append(f"fused_resume: vic turn3 got {status}")
+            return False
+        if vic_t3 != ctl_t3:
+            self.violations.append(
+                f"fused_resume: post-respawn turn diverged: "
+                f"{vic_t3!r} != {ctl_t3!r}"
+            )
+            return False
+        self.counts["fused_loops_after_resume"] = int(
+            (
+                self.services.backend.stats(
+                    self.services.manager.get_agent(fused_id).engine_id
+                )
+                or {}
+            ).get("fused_loops_total", 0)
+            or 0
+        )
+        return True
+
     def _affine_replica(self, agent_id: str, session: str) -> str:
         """Which replica the router pinned a session to (the kill target)."""
         router = self.services.router
@@ -830,6 +976,34 @@ async def run_soak(tmpdir: str) -> dict:
             # streams are unchanged, so the control comparison holds.
             env={"ATPU_FAULTS": "engine.decode_step:error=none,delay_ms=150"},
         )
+        fused_id = await soak.deploy(
+            "chaos-fused",
+            {
+                "engine": "llm",
+                "config": "tiny",
+                # fused on-device decode loop: up to decode_chunk forwards +
+                # in-loop sampling per dispatch, ONE readback at loop exit.
+                # speculative OFF for the same reason as chaos-fleet-llm:
+                # the kill must land inside plain fused decode, not after a
+                # prompt-lookup round already finished the turn.
+                "options": {
+                    "max_batch": 2,
+                    "max_seq": 256,
+                    "decode_chunk": 8,
+                    "prefill_chunk": 64,
+                    "kv_snapshot_interval_s": 0.5,
+                    "speculative": False,
+                    "fused_decode": True,
+                },
+            },
+            # delay-only failpoint on the FUSED dispatch seam (warmup
+            # exempt): 150 ms per while_loop window makes the 32-token
+            # victim turn take >= 0.6 s on every machine, so the 0.25 s
+            # kill offset deterministically interrupts a window whose
+            # packed readback the host has not seen yet. Delay-only: the
+            # greedy token stream is unchanged, the control holds.
+            env={"ATPU_FAULTS": "engine.fused_decode:error=none,delay_ms=150"},
+        )
         paged_id = await soak.deploy(
             "chaos-paged",
             {
@@ -857,14 +1031,16 @@ async def run_soak(tmpdir: str) -> dict:
         await soak.phase_poisoned_prefill(poison_id)
         backpressured = await soak.phase_page_exhaustion(paged_id)
         token_identical = await soak.phase_llm_resume(llm_id)
+        fused_identical = await soak.phase_fused_resume(fused_id)
         lease_ok = await soak.phase_lease_flap(fleet_echo_id)
         route_ok = await soak.phase_route_dead(fleet_echo_id)
         failover_ok = await soak.phase_replica_failover(fleet_llm_id)
 
         inv = await soak.settle(
-            [echo_id, poison_id, paged_id, llm_id, fleet_echo_id, fleet_llm_id]
+            [echo_id, poison_id, paged_id, llm_id, fused_id, fleet_echo_id, fleet_llm_id]
         )
         inv["token_identical_resume"] = token_identical
+        inv["fused_resume_token_identical"] = fused_identical
         inv["page_exhaustion_backpressure"] = backpressured
         inv["lease_flap_recovers"] = lease_ok
         inv["route_dead_absorbed"] = route_ok

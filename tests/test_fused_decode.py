@@ -1,0 +1,235 @@
+"""Fused on-device decode loop: A/B bit-exactness against the per-chunk path.
+
+``fused_decode=true`` swaps the decode dispatch for a multi-step
+``lax.while_loop`` — forward + in-loop sampling + per-lane EOS/budget
+masking, ONE readback per loop. Everything observable must be identical
+to ``fused_decode=false``: greedy token streams (solo, mixed batch,
+paged arena, speculation composed on top, snapshot/restore), EOS and
+max-token edges, ``ignore_eos``. The only legal difference is telemetry
+(fused counters move, host syncs per token drop).
+"""
+
+import asyncio
+
+import pytest
+
+from agentainer_tpu.engine.llm import LLMEngine
+
+OPTS = {"max_batch": 4, "max_seq": 128, "decode_chunk": 4}
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(scope="module")
+def base():
+    eng = LLMEngine.create("tiny", options=dict(OPTS, fused_decode=False))
+    eng.warmup()
+    yield eng
+    eng.shutdown()
+
+
+@pytest.fixture(scope="module")
+def fused():
+    eng = LLMEngine.create("tiny", options=dict(OPTS, fused_decode=True))
+    eng.warmup()
+    yield eng
+    eng.shutdown()
+
+
+def test_fused_flag_is_reported(base, fused):
+    assert base.metrics()["fused_decode"] is False
+    assert fused.metrics()["fused_decode"] is True
+
+
+def test_greedy_bit_exact_solo(base, fused):
+    a = run(base.generate("hello fused world", max_tokens=12, temperature=0.0))
+    b = run(fused.generate("hello fused world", max_tokens=12, temperature=0.0))
+    assert b["tokens"] == a["tokens"]
+    assert b["completion_tokens"] == a["completion_tokens"]
+
+
+def test_greedy_bit_exact_mixed_batch(base, fused):
+    """Four concurrent prompts of different lengths share one fused loop;
+    every lane must match its per-chunk twin token for token."""
+    prompts = ["a", "bb longer prompt", "ccc", "dddd even longer prompt here"]
+
+    async def batch(eng):
+        return await asyncio.gather(
+            *(eng.generate(p, max_tokens=10, temperature=0.0) for p in prompts)
+        )
+
+    want = run(batch(base))
+    got = run(batch(fused))
+    for w, g in zip(want, got):
+        assert g["tokens"] == w["tokens"]
+
+
+def test_fused_loop_counters_move(fused):
+    m = fused.metrics()
+    assert m["fused_loops_total"] > 0
+    assert m["fused_steps_total"] > 0
+    assert m["host_syncs_per_token"] is not None
+    assert sum(m["fused_exit_reason_hist"].values()) == m["fused_loops_total"]
+
+
+def test_greedy_bit_exact_paged(base):
+    eng = LLMEngine.create(
+        "tiny", options=dict(OPTS, fused_decode=True, paged_kv=True)
+    )
+    try:
+        a = run(base.generate("paged fused parity", max_tokens=12, temperature=0.0))
+        b = run(eng.generate("paged fused parity", max_tokens=12, temperature=0.0))
+        assert b["tokens"] == a["tokens"]
+        assert eng.metrics()["fused_loops_total"] > 0
+    finally:
+        eng.shutdown()
+
+
+def test_greedy_bit_exact_with_speculation(base):
+    """Speculation composes BETWEEN fused loops: spec rounds handle the
+    accept/rewind dance, fused loops the plain stretches — the merged
+    stream must still be the per-chunk greedy stream."""
+    eng = LLMEngine.create(
+        "tiny", options=dict(OPTS, fused_decode=True, speculative=True)
+    )
+    try:
+        a = run(base.generate("speculate then fuse", max_tokens=14, temperature=0.0))
+        b = run(eng.generate("speculate then fuse", max_tokens=14, temperature=0.0))
+        assert b["tokens"] == a["tokens"]
+    finally:
+        eng.shutdown()
+
+
+def test_max_tokens_at_loop_boundary(base, fused):
+    """Budgets that land exactly on a loop boundary (max_tokens a multiple
+    of decode_chunk) and ones that land mid-loop both finish at precisely
+    max_tokens, matching the per-chunk path."""
+    for n in (4, 8, 5, 3, 1):
+        a = run(
+            base.generate("boundary", max_tokens=n, temperature=0.0, ignore_eos=True)
+        )
+        b = run(
+            fused.generate("boundary", max_tokens=n, temperature=0.0, ignore_eos=True)
+        )
+        assert b["tokens"] == a["tokens"]
+        assert b["completion_tokens"] == a["completion_tokens"] == n
+
+
+def test_temperature_stream_deterministic_per_engine_seed(base, fused):
+    """Sampled decode draws from the engine's PRNG stream; fused and
+    per-chunk consume keys in the same order, so a fresh engine pair with
+    the same seed draws the same tokens."""
+    a = run(
+        base.generate("sample me", max_tokens=8, temperature=0.9, top_k=8, top_p=0.9)
+    )
+    b = run(
+        fused.generate("sample me", max_tokens=8, temperature=0.9, top_k=8, top_p=0.9)
+    )
+    assert len(a["tokens"]) == a["completion_tokens"]
+    assert len(b["tokens"]) == b["completion_tokens"]
+
+
+def _eos_patched_pair(eos_tok):
+    """Engine pair whose tokenizer EOS is pinned to a token the tiny model
+    actually emits — the only way to exercise in-loop EOS on a random
+    model. skip_warmup matters: create()'s warmup would bake the DEFAULT
+    eos id into the fused while_loop before the patch lands; lazily built
+    after the patch, the loop's in-loop EOS mask carries the pinned id."""
+    a = LLMEngine.create(
+        "tiny", options=dict(OPTS, fused_decode=False, skip_warmup=True)
+    )
+    b = LLMEngine.create(
+        "tiny", options=dict(OPTS, fused_decode=True, skip_warmup=True)
+    )
+    a.tokenizer.eos_id = eos_tok
+    b.tokenizer.eos_id = eos_tok
+    return a, b
+
+
+def test_eos_in_loop_and_at_first_step(base):
+    ref = run(base.generate("stop early", max_tokens=8, temperature=0.0,
+                            ignore_eos=True))
+    # eos == 2nd generated token → the fused loop's FIRST in-loop step
+    # trips the per-lane EOS mask; eos == 1st token → the prefill-boundary
+    # edge (finish before any fused loop runs)
+    for eos_tok in (int(ref["tokens"][1]), int(ref["tokens"][0])):
+        a, b = _eos_patched_pair(eos_tok)
+        try:
+            ra = run(a.generate("stop early", max_tokens=8, temperature=0.0))
+            rb = run(b.generate("stop early", max_tokens=8, temperature=0.0))
+            assert rb["tokens"] == ra["tokens"]
+            assert rb["completion_tokens"] == ra["completion_tokens"] < 8
+            assert int(ra["tokens"][-1]) == eos_tok
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+
+def test_eos_early_exit_is_counted():
+    """A batch that EOSes mid-loop exits the while_loop early: the
+    early-exit counter and the 'eos' bucket of the exit-reason histogram
+    must both move."""
+    probe = LLMEngine.create("tiny", options=dict(OPTS, fused_decode=False))
+    try:
+        ref = run(probe.generate("count exits", max_tokens=8, temperature=0.0,
+                                 ignore_eos=True))
+    finally:
+        probe.shutdown()
+    a, b = _eos_patched_pair(int(ref["tokens"][1]))
+    a.shutdown()
+    try:
+        run(b.generate("count exits", max_tokens=8, temperature=0.0))
+        m = b.metrics()
+        assert m["fused_early_exits_total"] > 0
+        assert m["fused_exit_reason_hist"].get("early_all_finished", 0) > 0
+    finally:
+        b.shutdown()
+
+
+def test_ignore_eos_honored_in_loop(base):
+    """ignore_eos must neutralize the in-loop EOS mask, not just the host
+    rescan: the lane runs to its full budget."""
+    ref = run(base.generate("ignore me", max_tokens=8, temperature=0.0,
+                            ignore_eos=True))
+    a, b = _eos_patched_pair(int(ref["tokens"][1]))
+    try:
+        ra = run(a.generate("ignore me", max_tokens=8, temperature=0.0,
+                            ignore_eos=True))
+        rb = run(b.generate("ignore me", max_tokens=8, temperature=0.0,
+                            ignore_eos=True))
+        assert rb["tokens"] == ra["tokens"]
+        assert rb["completion_tokens"] == ra["completion_tokens"] == 8
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_snapshot_restore_token_identical():
+    """Fused engine → snapshot → fresh fused engine → restore → continue:
+    the continued stream equals the per-chunk pair doing the same dance
+    (KV pages and carry survive the loop; resume is token-identical)."""
+    opts = {"max_batch": 2, "max_seq": 128, "decode_chunk": 4}
+
+    def one_mode(fused_on):
+        async def body():
+            e1 = LLMEngine.create("tiny", options=dict(opts, fused_decode=fused_on))
+            try:
+                first = await e1.chat("s", "turn one", max_tokens=6)
+                blob = await e1.snapshot_session("s")
+            finally:
+                e1.shutdown()
+            e2 = LLMEngine.create("tiny", options=dict(opts, fused_decode=fused_on))
+            try:
+                assert await e2.restore_session("s", blob) is True
+                second = await e2.chat("s", "turn two", max_tokens=6)
+            finally:
+                e2.shutdown()
+            return first["tokens"], second["tokens"]
+
+        return asyncio.run(body())
+
+    want = one_mode(False)
+    got = one_mode(True)
+    assert got == want

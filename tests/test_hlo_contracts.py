@@ -40,8 +40,9 @@ from agentainer_tpu.engine.llm import LLMEngine
 
 @pytest.fixture(scope="module")
 def engine():
-    """One shared paged+speculative tiny engine: the configuration whose
-    compile-key space is the largest (block tables, verify ladder, CoW)."""
+    """One shared paged+speculative+fused tiny engine: the configuration
+    whose compile-key space is the largest (block tables, verify ladder,
+    CoW, fused decode-loop rungs)."""
     eng = LLMEngine.create(
         "tiny",
         options={
@@ -51,6 +52,7 @@ def engine():
             "prefill_chunk": 32,
             "paged_kv": True,
             "speculative": True,
+            "fused_decode": True,
         },
     )
     yield eng
@@ -128,6 +130,38 @@ def test_engine_prefill_donation_actually_aliases(engine):
             tokens,
             pos,
             jnp.int32(4),
+        )
+        .compile()
+        .as_text()
+    )
+    check(hlo, DonationAliased(min_count=2))
+
+
+def test_fused_loop_donation_survives_while_carry(engine):
+    """The fused decode loop donates (cache, tok, pos) THROUGH the
+    while_loop carry: both KV pool leaves must alias compiled outputs, or
+    every fused dispatch pays a full arena copy — silently erasing the
+    loop's entire HBM win."""
+    B = engine.max_batch
+    live = jnp.zeros((B,), jnp.bool_)
+    budgets = jnp.zeros((B,), jnp.int32)
+    ign = jnp.zeros((B,), jnp.bool_)
+    keys = jax.random.split(jax.random.PRNGKey(0), 8)
+    hlo = (
+        engine._fused_fn(8)
+        .lower(
+            engine.params,
+            engine.cache,
+            jnp.asarray(engine._bt),
+            engine._dtok,
+            engine._dpos,
+            engine._dtemps,
+            engine._dtopk,
+            engine._dtopp,
+            live,
+            budgets,
+            ign,
+            keys,
         )
         .compile()
         .as_text()

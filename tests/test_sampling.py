@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from agentainer_tpu.engine.sampling import sample
+from agentainer_tpu.engine.sampling import sample, sample_step
 
 V = 8
 
@@ -44,3 +44,98 @@ def test_top_k_vocab_minus_one_excludes_only_the_min():
         seen.add(int(t[0]))
     assert 0 not in seen, seen
     assert seen == set(range(1, V)), seen
+
+
+# ---------------------------------------------------------------------------
+# sample vs sample_step parity: the fused decode loop's in-loop sampler
+# must draw the EXACT token sample() draws from the same key — fused
+# bit-exactness (test_fused_decode.py) reduces to this battery.
+
+
+def _step(logits, key, t, k, p):
+    B = logits.shape[0]
+    return sample_step(
+        logits,
+        key,
+        jnp.full((B,), t, jnp.float32),
+        jnp.full((B,), k, jnp.int32),
+        jnp.full((B,), p, jnp.float32),
+    )
+
+
+def _parity(t, k, p, keys=16, batch=4, seed=1):
+    logits = jax.random.normal(jax.random.PRNGKey(seed), (batch, V))
+    for i in range(keys):
+        kk = jax.random.fold_in(jax.random.PRNGKey(0), i)
+        want = sample(logits, kk, temperature=t, top_k=k, top_p=p)
+        got = _step(logits, kk, t, k, p)
+        assert got.tolist() == want.tolist(), (t, k, p, i)
+
+
+def test_step_parity_greedy():
+    _parity(0.0, 0, 1.0)
+
+
+def test_step_parity_temperature():
+    _parity(1.0, 0, 1.0)
+    _parity(0.3, 0, 1.0, seed=2)
+    _parity(2.5, 0, 1.0, seed=3)
+
+
+def test_step_parity_top_k():
+    _parity(1.0, 3, 1.0)
+    # the clamp edges from the tests above, now through the array sampler
+    _parity(1.0, V, 1.0, seed=2)
+    _parity(1.0, V + 7, 1.0, seed=3)
+    _parity(20.0, V - 1, 1.0, seed=4)
+
+
+def test_step_parity_top_p():
+    _parity(1.0, 0, 0.5)
+    _parity(1.0, 0, 0.9, seed=2)
+    _parity(1.0, 0, 1e-6, seed=3)  # keeps exactly the top token
+
+
+def test_step_parity_top_k_and_top_p():
+    _parity(0.7, 4, 0.8)
+    _parity(1.3, 2, 0.6, seed=2)
+
+
+def test_step_mixed_lane_batch():
+    """One batch mixing greedy / temperature / top-k / top-p lanes: each
+    lane must match what sample() produces when the whole batch runs at
+    that lane's settings (per-lane masks can't bleed across rows)."""
+    B = 4
+    lanes = [(0.0, 0, 1.0), (1.0, 0, 1.0), (0.8, 3, 1.0), (1.2, 0, 0.7)]
+    logits = jax.random.normal(jax.random.PRNGKey(9), (B, V))
+    temps = jnp.asarray([t for t, _, _ in lanes], jnp.float32)
+    topks = jnp.asarray([k for _, k, _ in lanes], jnp.int32)
+    topps = jnp.asarray([p for _, _, p in lanes], jnp.float32)
+    for i in range(16):
+        kk = jax.random.fold_in(jax.random.PRNGKey(5), i)
+        got = sample_step(logits, kk, temps, topks, topps)
+        for lane, (t, k, p) in enumerate(lanes):
+            want = sample(logits, kk, temperature=t, top_k=k, top_p=p)
+            assert int(got[lane]) == int(want[lane]), (lane, i)
+
+
+def test_step_mixed_lane_batch_jits_once():
+    """The whole point of the array sampler: different per-lane settings
+    are DATA, not compile-time constants — one jitted fn serves them all."""
+    fn = jax.jit(sample_step)
+    logits = jax.random.normal(jax.random.PRNGKey(3), (2, V))
+    key = jax.random.PRNGKey(4)
+    a = fn(
+        logits, key,
+        jnp.asarray([0.0, 1.0], jnp.float32),
+        jnp.asarray([0, 3], jnp.int32),
+        jnp.asarray([1.0, 0.8], jnp.float32),
+    )
+    b = fn(
+        logits, key,
+        jnp.asarray([1.0, 0.0], jnp.float32),
+        jnp.asarray([5, 0], jnp.int32),
+        jnp.asarray([0.5, 1.0], jnp.float32),
+    )
+    assert a.shape == b.shape == (2,)
+    assert fn._cache_size() == 1

@@ -144,6 +144,16 @@ def cmd_deploy(args) -> None:
         # A/B baseline even when the fleet default (features.fused_decode)
         # flips on
         option_overrides["fused_decode"] = bool(getattr(args, "fused_decode", False))
+    if getattr(args, "inloop_spec", False) or getattr(args, "no_inloop_spec", False):
+        # in-loop device speculation per deployment: --inloop-spec opts in
+        # (n-gram draft + verify inside the fused loop), --no-inloop-spec
+        # pins the host-side prompt-lookup drafter as the A/B baseline
+        option_overrides["inloop_spec"] = bool(getattr(args, "inloop_spec", False))
+    if getattr(args, "approx_topk", False) or getattr(args, "no_approx_topk", False):
+        # segmented approx top-k sampler per deployment: --approx-topk opts
+        # in (lax.approx_max_k segment, NOT bit-exact for sampled lanes),
+        # --no-approx-topk pins the exact shared-sort sampler baseline
+        option_overrides["approx_topk"] = bool(getattr(args, "approx_topk", False))
     if option_overrides:
         if isinstance(model, str):
             engine, _, config = model.partition(":")
@@ -498,6 +508,38 @@ def build_parser() -> argparse.ArgumentParser:
         help="pin this agent's engine to the per-chunk decode dispatch "
         "(the A/B baseline) even when the fleet default "
         "features.fused_decode is on",
+    )
+    inloop_group = s.add_mutually_exclusive_group()
+    inloop_group.add_argument(
+        "--inloop-spec",
+        action="store_true",
+        help="run this agent's fused decode loop with in-loop device "
+        "speculation (n-gram draft + batched verify inside the "
+        "while_loop; lanes stay loop-resident while speculating; same as "
+        "options.inloop_spec: true in a deployment YAML)",
+    )
+    inloop_group.add_argument(
+        "--no-inloop-spec",
+        action="store_true",
+        help="pin this agent's engine to the host-side prompt-lookup "
+        "drafter (the A/B baseline) even when the fleet default "
+        "features.inloop_spec is on",
+    )
+    approx_group = s.add_mutually_exclusive_group()
+    approx_group.add_argument(
+        "--approx-topk",
+        action="store_true",
+        help="run this agent's sampler with the segmented approx top-k "
+        "path (jax.lax.approx_max_k over a fixed segment instead of the "
+        "full-vocab sort; NOT bit-exact for sampled lanes; same as "
+        "options.approx_topk: true in a deployment YAML)",
+    )
+    approx_group.add_argument(
+        "--no-approx-topk",
+        action="store_true",
+        help="pin this agent's engine to the exact shared-sort sampler "
+        "(the default baseline) even when the fleet default "
+        "features.approx_topk is on",
     )
     s.add_argument("--health-endpoint", default="")
     s.add_argument("--health-interval", type=float, default=30.0)

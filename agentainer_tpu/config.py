@@ -61,6 +61,17 @@ class FeatureFlags:
     # readback per loop). Off by default while the per-chunk dispatch
     # remains the A/B baseline; per-deployment model options override.
     fused_decode: bool = False
+    # Default for engines' in-loop device speculation: the fused loop's
+    # n-gram drafter + batched verify branch, replacing the host-side
+    # prompt-lookup round-trip while a lane stays loop-resident. On by
+    # default — it only engages when the engine is fused+speculative and
+    # unmeshed, and greedy lanes are bit-exact with the host drafter.
+    inloop_spec: bool = True
+    # Default for engines' segmented approx top-k sampler
+    # (jax.lax.approx_max_k over a fixed-width segment instead of the
+    # full-vocab sort). Off by default: the exact shared-sort sampler is
+    # the baseline; approx is opt-in and NOT bit-exact for sampled lanes.
+    approx_topk: bool = False
 
 
 @dataclass
@@ -385,6 +396,24 @@ def load_config(path: str | None = None) -> Config:
     )
     if "ATPU_FUSED_DECODE" in env:
         cfg.features.fused_decode = env["ATPU_FUSED_DECODE"].lower() in (
+            "1",
+            "true",
+            "yes",
+        )
+    cfg.features.inloop_spec = bool(
+        feats.get("inloop_spec", cfg.features.inloop_spec)
+    )
+    if "ATPU_INLOOP_SPEC" in env:
+        cfg.features.inloop_spec = env["ATPU_INLOOP_SPEC"].lower() in (
+            "1",
+            "true",
+            "yes",
+        )
+    cfg.features.approx_topk = bool(
+        feats.get("approx_topk", cfg.features.approx_topk)
+    )
+    if "ATPU_APPROX_TOPK" in env:
+        cfg.features.approx_topk = env["ATPU_APPROX_TOPK"].lower() in (
             "1",
             "true",
             "yes",

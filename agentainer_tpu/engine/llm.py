@@ -69,6 +69,23 @@ PAGE_SIZE_DEFAULT = 64
 # compiled k-token verify program per bucket, warmed at startup, the round's
 # bucket chosen as the smallest covering the longest draft in the batch.
 SPEC_VERIFY_BUCKETS = (2, 4, 8)
+
+# In-loop device speculation (ISSUE 17): the fused while_loop's own n-gram
+# drafter matches each lane's trailing 3/2-gram against a fixed window of
+# its recent token history (carried ON DEVICE across loops) and verifies up
+# to FUSED_SPEC_K drafted tokens as a batched branch of the same loop body —
+# the lane never exits the loop to speculate. Window width trades match
+# recall against per-iteration compare cost ([B, W, 3] equality — trivial
+# next to a forward); 64 covers the tool-call/JSON span lengths the host
+# drafter feeds on.
+FUSED_HIST_W = 64
+FUSED_SPEC_K = 4
+# Dynamic fused rung: the loop bound is a RUNTIME operand, so one compiled
+# executable serves every rung and the uncontended dispatch rides a rung
+# this many times the configured decode_chunk — amortizing per-dispatch
+# overhead (host bookkeeping, transfers, readback processing) that the
+# b1/b4 decode-loop bench showed dominating fused ITL.
+FUSED_RUNG_MULT = 4
 # acceptance-rate EMA: fast-collapsing (a handful of all-rejected rounds
 # sends gamma to 0) so adversarial/low-match traffic degrades to the plain
 # decode ladder instead of paying verify forwards that never accept
@@ -349,6 +366,8 @@ class LLMEngine:
         page_size: int = PAGE_SIZE_DEFAULT,
         kv_pages: int = 0,
         fused_decode: bool = False,
+        inloop_spec: bool = True,
+        approx_topk: bool = False,
     ):
         self.cfg = cfg
         self.tokenizer = tokenizer
@@ -388,6 +407,10 @@ class LLMEngine:
                 f"pp={self.pp} (per-chunk dispatch retained)",
                 flush=True,
             )
+        # Segmented approx top-k sampler (opt-in; exact shared-sort sampler
+        # is the default). Static per engine: it picks which sample_step
+        # pipeline every compiled decode path bakes in.
+        self.approx_topk = bool(approx_topk)
         self.page_size = max(8, int(page_size or PAGE_SIZE_DEFAULT))
         if self.paged:
             # the logical arena must tile exactly into pages
@@ -617,6 +640,13 @@ class LLMEngine:
                 jnp.zeros((max_batch,), jnp.float32),
                 jnp.zeros((max_batch,), jnp.int32),  # top_k (0 = disabled)
                 jnp.ones((max_batch,), jnp.float32),  # top_p (1 = disabled)
+                # in-loop spec history ring (right-aligned recent tokens)
+                # + per-lane valid count; dead weight when the fused loop
+                # or in-loop spec is off (W ints per lane — negligible),
+                # kept in the carry unconditionally so every injection and
+                # reallocation path has ONE shape.
+                jnp.zeros((max_batch, FUSED_HIST_W), jnp.int32),
+                jnp.zeros((max_batch,), jnp.int32),
             )
 
         if self.mesh is not None:
@@ -624,7 +654,7 @@ class LLMEngine:
 
             repl = _NS(self.mesh, _P())
             self._alloc_carry = jax.jit(
-                _mk_carry, out_shardings=(repl, repl, repl, repl, repl)
+                _mk_carry, out_shardings=(repl,) * 7
             )
         else:
             # committed (see the cache comment above): first-use and
@@ -636,7 +666,35 @@ class LLMEngine:
             self._dtemps,
             self._dtopk,
             self._dtopp,
+            self._dhist,
+            self._dhlen,
         ) = self._alloc_carry()
+        # Double-buffered lane injection (ISSUE 17): a capacity-1 staging
+        # slot a running fused loop absorbs at its next dispatch boundary.
+        # The staged lane's (token, position, sampler params, spec history)
+        # are scattered into these shadow arrays OUTSIDE the loop via the
+        # same jitted _inject scatter the live carry uses; the next fused
+        # dispatch ships a per-lane `armed` mask and the loop's entry merge
+        # reads staged state for armed lanes — so a finished prefill starts
+        # decoding WITHOUT the host waiting on the in-flight loop's
+        # readback (exit-and-redispatch put that host RTT on the device's
+        # idle path). _staged_lane tracks occupancy; an occupied slot falls
+        # back to the direct-injection path (today's behavior).
+        (
+            self._stok,
+            self._spos,
+            self._stemps,
+            self._stopk,
+            self._stopp,
+            self._shist,
+            self._shlen,
+        ) = self._alloc_carry()
+        self._staged_lane: int | None = None
+        # instance toggle (not a constructor flag quad: injection is a
+        # fused-dispatch internal, A/B'd by tests flipping this directly)
+        self._fused_inject = self.fused_decode
+        self.fused_injections_total = 0
+        self.fused_inject_fallbacks_total = 0
         # FIFO of lagged readbacks: ("first", slot, req, first_dev, t) and
         # ("chunk", [(slot, req, start_pos)...], toks_dev, t); staleness is
         # detected by `slot.request is not req` identity at processing time
@@ -798,6 +856,21 @@ class LLMEngine:
         # program covers and the round's bucket pick would fail
         self.spec_gamma_max = self._spec_buckets[-1]
         self._verify_fns: dict[int, Any] = {}
+        # In-loop device speculation: the fused loop drafts and verifies on
+        # device, so speculating lanes stay loop-resident (the host-side
+        # drafter forces a loop exit + synchronous verify round-trip every
+        # round). Requires the fused loop and the speculative flag; meshed
+        # engines keep the host drafter — the draft/verify lax.cond inside
+        # the loop body trips the same XLA:CPU partitioner segfault the
+        # sampler's greedy cond does over sharded operands.
+        self.inloop_spec = (
+            bool(inloop_spec)
+            and self.fused_decode
+            and bool(speculative)
+            and self.mesh is None
+        )
+        self.inloop_spec_drafted = 0
+        self.inloop_spec_accepted = 0
         self._spec_active = self.speculative  # warmup serves with it off
         self.spec_rounds = 0
         self.spec_drafted = 0
@@ -811,6 +884,11 @@ class LLMEngine:
         # output bumps host_syncs_total, so syncs/token quantifies the
         # one-readback-per-loop claim against the per-chunk baseline.
         self._fused_fns: dict[int, Any] = {}
+        # dynamic-rung cap: the single compiled loop's static sizing bound
+        # (emitted buffer, key ladder); the runtime loop bound `nsteps` is
+        # an operand, so dispatch picks any rung in [1, cap] at zero
+        # compile cost and the uncontended steady state rides the top
+        self._fused_cap = max(self.decode_chunk, FUSED_RUNG_MULT * self.decode_chunk)
         self.fused_loops_total = 0
         self.fused_steps_total = 0
         self.fused_early_exits_total = 0
@@ -942,6 +1020,8 @@ class LLMEngine:
                 page_size=int(options.get("page_size", PAGE_SIZE_DEFAULT) or PAGE_SIZE_DEFAULT),
                 kv_pages=int(options.get("kv_pages", 0) or 0),
                 fused_decode=bool(options.get("fused_decode", False)),
+                inloop_spec=bool(options.get("inloop_spec", True)),
+                approx_topk=bool(options.get("approx_topk", False)),
             )
             if not options.get("skip_warmup"):
                 engine.warmup()
@@ -1071,6 +1151,8 @@ class LLMEngine:
             page_size=int(options.get("page_size", PAGE_SIZE_DEFAULT) or PAGE_SIZE_DEFAULT),
             kv_pages=int(options.get("kv_pages", 0) or 0),
             fused_decode=bool(options.get("fused_decode", False)),
+            inloop_spec=bool(options.get("inloop_spec", True)),
+            approx_topk=bool(options.get("approx_topk", False)),
         )
         # pay the decode/prefill compiles here (inside the loader thread, while
         # /health keeps answering) instead of on the first user request.
@@ -1180,6 +1262,7 @@ class LLMEngine:
                 nxt = sample_step(
                     logits[:, 0], key, temps, topk, topp,
                     greedy_cond=self.mesh is None,
+                    approx_topk=self.approx_topk,
                 )
                 # clamp: parked (idle/finished) lanes decode forever at the
                 # scratch position — real lanes never reach it (admission
@@ -1194,17 +1277,27 @@ class LLMEngine:
             # between cache and the token state); the body is decode_n
             return decode_n(params, cache, tokens, positions, temps, topk, topp, keys, bt)
 
-        def inject(tok, pos, temps, topk, topp, idx, first, position, temp, tk, tp_):
+        def inject(
+            tok, pos, temps, topk, topp, hist, hlen,
+            idx, first, position, temp, tk, tp_, hist_row, hist_n,
+        ):
             """Point a slot's decode lane at its prefill result: lane `idx`
             continues from `first` (the sampled first token, still on
             device) at `position`. Idle/finished lanes are parked the same
-            way with first=0, position=scratch."""
+            way with first=0, position=scratch. The in-loop spec history is
+            seeded in the same scatter: ``hist_row`` carries the host-built
+            prompt tail shifted left one slot, and ``first`` (still a
+            device value) lands in the newest slot — so the drafter's first
+            trailing gram already includes the first generated token."""
+            row = jnp.concatenate([hist_row[1:], first[None].astype(jnp.int32)])
             return (
                 tok.at[idx].set(first),
                 pos.at[idx].set(position),
                 temps.at[idx].set(temp),
                 topk.at[idx].set(tk),
                 topp.at[idx].set(tp_),
+                hist.at[idx].set(row),
+                hlen.at[idx].set(hist_n),
             )
 
         if self.paged:
@@ -1213,87 +1306,222 @@ class LLMEngine:
         else:
             self._prefill = jax.jit(prefill, donate_argnums=(1,))
             self._decode_n = jax.jit(decode_n, donate_argnums=(1, 2, 3))
-        self._inject = jax.jit(inject, donate_argnums=(0, 1, 2, 3, 4))
+        self._inject = jax.jit(inject, donate_argnums=(0, 1, 2, 3, 4, 5, 6))
         # the verify ladder reuses the same forward (one prefill-shaped call
         # with t = k+1 per round); fns are built per bucket on demand and
         # warmed alongside the decode ladder
         self._run_forward = run_forward
 
-    def _fused_fn(self, chunk: int):
-        """Compiled fused decode loop for ladder rung ``chunk`` (ISSUE 10):
-        a ``lax.while_loop`` running up to ``chunk`` forward+sample+append
-        steps entirely on device, with per-lane EOS/budget masking and a
-        whole-batch early-exit predicate — so the only host↔device traffic
-        per loop is the dispatch and ONE packed readback at loop exit.
+    def _fused_fn(self):
+        """Compiled fused decode loop (ISSUE 10, reworked for ISSUE 17): a
+        ``lax.while_loop`` running up to ``nsteps`` iterations entirely on
+        device, with per-lane EOS masking, in-loop n-gram speculation, a
+        double-buffered injection slot, and a whole-batch early-exit
+        predicate — the only host↔device traffic per loop is the dispatch
+        and ONE packed readback at loop exit.
 
-        Carry: (i, tok, pos, cache, done, emitted[chunk,B], nemit[B],
-        reason[B]). ``done`` starts true for parked lanes (``~live``) and
-        budget-exhausted lanes; a live lane goes done when it samples EOS
-        (unless ``ign``) or its emitted count reaches its budget, at which
-        point it parks IN-LOOP at the scratch position — the finishing
-        token is recorded but never fed, so the host finishes it with
-        ``pending_last=True`` (the same carry-into-next-prompt semantics
-        the unfused boundary finish uses). The loop exits when every lane
-        is done or ``chunk`` steps ran. Sampling is ``sample_step`` over
-        the per-lane (temperature, top_k, top_p) carry with the SAME
-        per-dispatch key ladder the unfused scan consumes — greedy lanes
-        are bit-exact with ``fused_decode=False`` and temperature lanes
-        draw identically from identical keys.
+        Dynamic rung: ``nsteps`` is a RUNTIME int32 operand; buffers are
+        sized by the static cap ``self._fused_cap``, so ONE executable
+        serves every rung of the adaptive ladder (recompile budget stays 0
+        by construction) and long uncontended rungs amortize dispatch
+        overhead without new compiles.
 
-        Readback packing: one int32 [chunk+3, B] array — rows [0, chunk)
-        are emitted tokens (-1 past a lane's count), row ``chunk`` the
-        per-lane counts, row ``chunk+1`` the finish reasons (0 running /
-        1 EOS / 2 budget), row ``chunk+2`` the executed step count
-        (broadcast) — tokens, lengths, and finish reasons cross the host
-        boundary in exactly one transfer."""
-        fn = self._fused_fns.get(chunk)
+        Injection slot: ``armed`` flags lanes whose staged shadow state
+        (stok/spos/... written by ``_stage_lane`` while the previous loop
+        was in flight) replaces the carry at entry — a freshly prefilled
+        request is absorbed by the already-pipelined next loop without an
+        exit-and-redispatch bubble.
+
+        In-loop speculation (greedy lanes only): each iteration drafts up
+        to ``FUSED_SPEC_K`` tokens by matching the lane's trailing 3-gram
+        (2-gram fallback) against its ``FUSED_HIST_W``-token history
+        carry, then verifies the drafts as a batched [B, K+1] forward in a
+        ``lax.cond`` branch of the SAME loop body. Acceptance is argmax
+        agreement, so greedy lanes stay bit-exact with both
+        ``speculative=False`` and the host-side drafter; sampled lanes
+        never draft (dlen=0) and consume exactly ``keys[i]`` per
+        iteration, so their streams are identical too.
+
+        Budget handling: ``budgets`` is a per-loop emission cap
+        (min(remaining, chunk+1) estimated by the host). The device NEVER
+        declares a budget finish — a lane hitting its cap freezes
+        (``full``: real tok/pos retained, reason stays 0, excluded from
+        the active set) and the authoritative host rescan in
+        ``_process_fused`` decides. Host dispatch counts iterations, not
+        emissions, so the estimate only ever OVERSHOOTS remaining budget —
+        the safe direction under pipelined dispatch (a device park the
+        host disagrees with would let the in-flight next loop decode a
+        host-live lane at scratch).
+
+        Readback packing: one int32 [cap+6, B] array — rows [0, cap+1)
+        emitted tokens (-1 past a lane's count), then per-lane counts,
+        finish reasons (0 running / 1 EOS), executed iteration count
+        (broadcast), accepted-draft and drafted counts."""
+        fn = self._fused_fns.get(self._fused_cap)
         if fn is not None:
             return fn
         run_forward = self._run_forward
         scratch_static = self.max_seq - 1
         eos_id = int(self.tokenizer.eos_id)
+        cap_rows = self._fused_cap + 1  # budgets clamp at chunk+1 emissions
+        K = FUSED_SPEC_K
+        W = FUSED_HIST_W
+        inloop_spec = self.inloop_spec
+        approx = self.approx_topk
+        greedy_cond = self.mesh is None
+        # Static index matrices for the n-gram drafter: row d-1 of idx3
+        # addresses the 3-token window at distance d back from the trailing
+        # 3-gram (d in 1..W-3); first match = smallest d via argmax.
+        d3_vals = jnp.arange(1, W - 2, dtype=jnp.int32)
+        idx3 = (W - 3 - d3_vals)[:, None] + jnp.arange(3)[None, :]
+        d2_vals = jnp.arange(1, W - 1, dtype=jnp.int32)
+        idx2 = (W - 2 - d2_vals)[:, None] + jnp.arange(2)[None, :]
 
         def fused_body(  # atp: hot
-            params, cache, tok, pos, temps, topk, topp, live, budgets, ign, keys, bt=None
+            params, cache, tok, pos, temps, topk, topp, hist, hlen,
+            stok, spos, stemps, stopk, stopp, shist, shlen,
+            armed, live, budgets, ign, keys, nsteps, bt=None,
         ):
             scratch = cache.k.shape[2] - 1 if bt is None else scratch_static
             B = tok.shape[0]
+            # Absorb the staged lane (if armed) at loop entry — the shadow
+            # state was written while the previous loop was in flight.
+            tok = jnp.where(armed, stok, tok)
+            pos = jnp.where(armed, spos, pos)
+            temps = jnp.where(armed, stemps, temps)
+            topk = jnp.where(armed, stopk, topk)
+            topp = jnp.where(armed, stopp, topp)
+            hist = jnp.where(armed[:, None], shist, hist)
+            hlen = jnp.where(armed, shlen, hlen)
+            lane = jnp.arange(B)
+
+            def draft_from_hist(hist, hlen):
+                tail3 = hist[:, W - 3:]
+                win3 = hist[:, idx3]  # [B, D3, 3]
+                m3 = jnp.all(win3 == tail3[:, None, :], -1) & (
+                    hlen[:, None] >= d3_vals[None, :] + 3
+                )
+                any3 = jnp.any(m3, 1)
+                dstar3 = d3_vals[jnp.argmax(m3, 1)]
+                tail2 = hist[:, W - 2:]
+                win2 = hist[:, idx2]
+                m2 = jnp.all(win2 == tail2[:, None, :], -1) & (
+                    hlen[:, None] >= d2_vals[None, :] + 2
+                )
+                any2 = jnp.any(m2, 1)
+                dstar2 = d2_vals[jnp.argmax(m2, 1)]
+                dstar = jnp.where(any3, dstar3, dstar2)
+                exists = any3 | any2
+                gidx = jnp.minimum(
+                    (W - dstar)[:, None] + jnp.arange(K)[None, :], W - 1
+                )
+                drafts = jnp.take_along_axis(hist, gidx, axis=1)  # [B, K]
+                return exists, dstar, drafts
 
             def cond(c):
-                i, _, _, _, done, _, _, _ = c
-                return (i < chunk) & jnp.any(~done)
+                i, done, full = c[0], c[4], c[5]
+                return (i < nsteps) & jnp.any(~(done | full))
 
             def body(c):
-                i, tok, pos, cache, done, emitted, nemit, reason = c
-                logits, cache = run_forward(
-                    params, tok[:, None], pos[:, None], cache, bt
-                )
-                nxt = sample_step(
-                    logits[:, 0], keys[i], temps, topk, topp,
-                    greedy_cond=self.mesh is None,
-                )
-                rec = ~done  # lanes still recording output this step
-                emitted = lax.dynamic_update_index_in_dim(
-                    emitted, jnp.where(rec, nxt, -1), i, axis=0
-                )
-                nemit = nemit + rec.astype(jnp.int32)
-                hit_eos = rec & (nxt == eos_id) & (~ign)
-                hit_max = rec & (nemit >= budgets)
-                reason = jnp.where((reason == 0) & hit_eos, 1, reason)
-                reason = jnp.where((reason == 0) & hit_max, 2, reason)
-                done = done | hit_eos | hit_max
-                # a finishing lane parks IN-LOOP: its sampled token is
-                # recorded but never fed, and its position pins at scratch
-                # (the idle-lane write target) — unlike the unfused chunk,
-                # which keeps overshooting real positions until the host
-                # notices. Live lanes advance exactly like the unfused scan.
-                tok = jnp.where(done, tok, nxt)
+                (i, tok, pos, cache, done, full, emitted, nemit, reason,
+                 hist, hlen, nacc, ndr) = c
+                rec = ~(done | full)
+                room = budgets - nemit
+                zeros_b = jnp.zeros((B,), jnp.int32)
+
+                def _plain(cache):
+                    logits, cache = run_forward(
+                        params, tok[:, None], pos[:, None], cache, bt
+                    )
+                    nxt = sample_step(
+                        logits[:, 0], keys[i], temps, topk, topp,
+                        greedy_cond=greedy_cond, approx_topk=approx,
+                    )
+                    cand = jnp.concatenate(
+                        [nxt[:, None], jnp.zeros((B, K), jnp.int32)], 1
+                    )
+                    return cache, cand, rec.astype(jnp.int32), zeros_b, zeros_b
+
+                if inloop_spec:
+                    exists, dstar, drafts = draft_from_hist(hist, hlen)
+                    # draft only greedy active lanes with budget headroom;
+                    # continuation length is capped by the match distance
+                    # (the tokens that followed the matched occurrence)
+                    dlen = jnp.where(
+                        exists & (temps <= 0.0) & rec,
+                        jnp.minimum(
+                            jnp.minimum(dstar, K), jnp.maximum(room - 1, 0)
+                        ),
+                        0,
+                    )
+
+                    def _with_spec(cache):
+                        toks = jnp.concatenate([tok[:, None], drafts], 1)
+                        posm = jnp.minimum(
+                            pos[:, None] + jnp.arange(K + 1)[None, :], scratch
+                        )
+                        logits, cache = run_forward(params, toks, posm, cache, bt)
+                        greedy = jnp.argmax(logits, -1).astype(jnp.int32)
+                        valid = jnp.arange(K)[None, :] < dlen[:, None]
+                        ok = (drafts == greedy[:, :K]) & valid
+                        a = jnp.cumprod(ok.astype(jnp.int32), 1).sum(1)
+                        nxt0 = sample_step(
+                            logits[:, 0], keys[i], temps, topk, topp,
+                            greedy_cond=greedy_cond, approx_topk=approx,
+                        )
+                        # position j>0 emits the verifier's argmax: token j
+                        # is either an accepted draft (== argmax by the
+                        # acceptance rule) or the correction token
+                        cand = jnp.concatenate([nxt0[:, None], greedy[:, 1:]], 1)
+                        navail = jnp.where(rec, a + 1, 0)
+                        return (
+                            cache, cand, navail,
+                            jnp.where(rec, a, 0), jnp.where(rec, dlen, 0),
+                        )
+
+                    cache, cand, navail, acc, dln = lax.cond(
+                        jnp.any(dlen > 0), _with_spec, _plain, cache
+                    )
+                else:
+                    cache, cand, navail, acc, dln = _plain(cache)
+
+                navail = jnp.minimum(navail, jnp.maximum(room, 0))
+                is_emit = jnp.arange(K + 1)[None, :] < navail[:, None]
+                is_eos = is_emit & (cand == eos_id) & (~ign[:, None])
+                has_eos = jnp.any(is_eos, 1)
+                cnt = jnp.where(has_eos, jnp.argmax(is_eos, 1) + 1, navail)
+                nemit = nemit + cnt
+                reason = jnp.where((reason == 0) & has_eos, 1, reason)
+                done = done | has_eos
+                # cap-hit lanes FREEZE at their real tok/pos with reason 0:
+                # the host rescan (authoritative for budget) either finishes
+                # them or lets the already-pipelined next loop continue them
+                full = full | (rec & ~has_eos & (nemit >= budgets))
+                last = jnp.take_along_axis(
+                    cand, jnp.maximum(cnt - 1, 0)[:, None], 1
+                )[:, 0]
+                tok = jnp.where(cnt > 0, last, tok)
+                # EOS lanes park at scratch (finishing token recorded, never
+                # fed); frozen/live lanes keep real positions
                 pos = jnp.where(
                     done,
                     jnp.full_like(pos, scratch),
-                    jnp.minimum(pos + 1, scratch),
+                    jnp.minimum(pos + cnt, scratch),
                 )
-                return (i + 1, tok, pos, cache, done, emitted, nemit, reason)
+                for j in range(K + 1):
+                    ridx = jnp.where(j < cnt, nemit - cnt + j, cap_rows)
+                    emitted = emitted.at[ridx, lane].set(
+                        cand[:, j], mode="drop"
+                    )
+                ext = jnp.concatenate([hist, cand], 1)
+                hist = jnp.take_along_axis(
+                    ext, jnp.arange(W)[None, :] + cnt[:, None], 1
+                )
+                hlen = jnp.minimum(hlen + cnt, W)
+                return (
+                    i + 1, tok, pos, cache, done, full, emitted, nemit,
+                    reason, hist, hlen, nacc + acc, ndr + dln,
+                )
 
             init = (
                 jnp.int32(0),
@@ -1301,36 +1529,50 @@ class LLMEngine:
                 pos,
                 cache,
                 ~live | (budgets <= 0),
-                jnp.full((chunk, B), -1, jnp.int32),
+                jnp.zeros((B,), bool),
+                jnp.full((cap_rows, B), -1, jnp.int32),
+                jnp.zeros((B,), jnp.int32),
+                jnp.zeros((B,), jnp.int32),
+                hist,
+                hlen,
                 jnp.zeros((B,), jnp.int32),
                 jnp.zeros((B,), jnp.int32),
             )
-            i, tok, pos, cache, done, emitted, nemit, reason = lax.while_loop(
-                cond, body, init
-            )
+            (i, tok, pos, cache, done, full, emitted, nemit, reason,
+             hist, hlen, nacc, ndr) = lax.while_loop(cond, body, init)
             packed = jnp.concatenate(
                 [
                     emitted,
                     nemit[None, :],
                     reason[None, :],
                     jnp.broadcast_to(i, (1, B)).astype(jnp.int32),
+                    nacc[None, :],
+                    ndr[None, :],
                 ],
                 axis=0,
             )
-            return packed, tok, pos, cache
+            return packed, tok, pos, temps, topk, topp, hist, hlen, cache
 
         if self.paged:
 
             def fused_paged(
-                params, cache, bt, tok, pos, temps, topk, topp, live, budgets, ign, keys
+                params, cache, bt, tok, pos, temps, topk, topp, hist, hlen,
+                stok, spos, stemps, stopk, stopp, shist, shlen,
+                armed, live, budgets, ign, keys, nsteps,
             ):
                 return fused_body(
-                    params, cache, tok, pos, temps, topk, topp, live, budgets, ign, keys, bt
+                    params, cache, tok, pos, temps, topk, topp, hist, hlen,
+                    stok, spos, stemps, stopk, stopp, shist, shlen,
+                    armed, live, budgets, ign, keys, nsteps, bt,
                 )
 
-            fn = self._fused_fns[chunk] = jax.jit(fused_paged, donate_argnums=(1, 3, 4))
+            fn = self._fused_fns[self._fused_cap] = jax.jit(
+                fused_paged, donate_argnums=(1, 3, 4, 5, 6, 7, 8, 9)
+            )
         else:
-            fn = self._fused_fns[chunk] = jax.jit(fused_body, donate_argnums=(1, 2, 3))
+            fn = self._fused_fns[self._fused_cap] = jax.jit(
+                fused_body, donate_argnums=(1, 2, 3, 4, 5, 6, 7, 8)
+            )
         return fn
 
     def warmup(self) -> None:
@@ -1496,6 +1738,10 @@ class LLMEngine:
         self.fused_steps_total = 0
         self.fused_early_exits_total = 0
         self.fused_exit_reason_hist = {}
+        self.fused_injections_total = 0
+        self.fused_inject_fallbacks_total = 0
+        self.inloop_spec_drafted = 0
+        self.inloop_spec_accepted = 0
         self.host_syncs_total = 0
         self._prefix_entries.clear()
         self._prefix_bytes = 0
@@ -2500,6 +2746,21 @@ class LLMEngine:
             "fused_exit_reason_hist": dict(
                 sorted(self.fused_exit_reason_hist.copy().items())
             ),
+            # ISSUE 17: double-buffered lane injection (staged absorbs vs
+            # exit-and-redispatch fallbacks) and in-loop n-gram speculation
+            # (device-counted drafted/accepted, read back in the packed
+            # loop transfer — no extra syncs)
+            "fused_injections_total": self.fused_injections_total,
+            "fused_inject_fallbacks_total": self.fused_inject_fallbacks_total,
+            "inloop_spec": self.inloop_spec,
+            "inloop_spec_drafted": self.inloop_spec_drafted,
+            "inloop_spec_accepted": self.inloop_spec_accepted,
+            "inloop_spec_acceptance_rate": (
+                round(self.inloop_spec_accepted / self.inloop_spec_drafted, 4)
+                if self.inloop_spec_drafted
+                else None
+            ),
+            "approx_topk": self.approx_topk,
             "host_syncs_total": self.host_syncs_total,
             "host_syncs_per_token": (
                 round(self.host_syncs_total / self.tokens_generated, 4)
@@ -2730,8 +2991,10 @@ class LLMEngine:
                     # speculative verify round when lanes have drafts;
                     # otherwise (or under contention) the plain pipelined
                     # decode-chunk path — gamma collapse makes low-match
-                    # traffic live here permanently
-                    if not self._try_speculate():
+                    # traffic live here permanently. With in-loop spec the
+                    # drafter/verifier run INSIDE the fused loop body, so
+                    # the host-side round-trip is skipped entirely.
+                    if self.inloop_spec or not self._try_speculate():
                         if self.fused_decode:
                             self._fused_dispatch()
                         else:
@@ -2879,34 +3142,87 @@ class LLMEngine:
             self._abandon_slot(slot)
 
     def _inject_lane(
-        self, idx: int, first, position: int, temp: float, top_k: int, top_p: float
+        self, idx: int, first, position: int, temp: float, top_k: int, top_p: float,
+        hist_row=None, hist_n: int = 0,
     ) -> None:
-        """Jitted single-lane scatter into the 5-array decode carry (token,
-        position, temperature, top_k, top_p)."""
+        """Jitted single-lane scatter into the 7-array decode carry (token,
+        position, temperature, top_k, top_p, spec history, history length).
+        ``hist_row`` seeds the in-loop drafter with the prompt tail (host
+        int32 [FUSED_HIST_W], left-shifted in the scatter so ``first``
+        lands in the newest slot); None parks the history empty."""
+        if hist_row is None:
+            hist_row = jnp.zeros((FUSED_HIST_W,), jnp.int32)
         (
             self._dtok,
             self._dpos,
             self._dtemps,
             self._dtopk,
             self._dtopp,
+            self._dhist,
+            self._dhlen,
         ) = self._inject(
             self._dtok,
             self._dpos,
             self._dtemps,
             self._dtopk,
             self._dtopp,
+            self._dhist,
+            self._dhlen,
             jnp.int32(idx),
             first,
             jnp.int32(position),
             jnp.float32(temp),
             jnp.int32(top_k),
             jnp.float32(top_p),
+            hist_row,
+            jnp.int32(hist_n),
+        )
+
+    def _stage_lane(
+        self, idx: int, first, position: int, temp: float, top_k: int, top_p: float,
+        hist_row=None, hist_n: int = 0,
+    ) -> None:
+        """Write a freshly prefilled lane into the STAGING shadow carry
+        instead of the live one: the already-dispatched fused loop absorbs
+        it at entry via the ``armed`` flag (double-buffered injection) —
+        continuous batching without exiting the running loop. Same jitted
+        scatter as ``_inject_lane``, pointed at the shadow arrays."""
+        if hist_row is None:
+            hist_row = jnp.zeros((FUSED_HIST_W,), jnp.int32)
+        (
+            self._stok,
+            self._spos,
+            self._stemps,
+            self._stopk,
+            self._stopp,
+            self._shist,
+            self._shlen,
+        ) = self._inject(
+            self._stok,
+            self._spos,
+            self._stemps,
+            self._stopk,
+            self._stopp,
+            self._shist,
+            self._shlen,
+            jnp.int32(idx),
+            first,
+            jnp.int32(position),
+            jnp.float32(temp),
+            jnp.int32(top_k),
+            jnp.float32(top_p),
+            hist_row,
+            jnp.int32(hist_n),
         )
 
     def _park_lane(self, idx: int) -> None:
         """Point a lane at the scratch position with neutral sampling state
         (idle/finished/aborted lanes all park identically)."""
         self._inject_lane(idx, jnp.int32(0), self.scratch_pos, 0.0, 0, 1.0)
+        if self._staged_lane == idx:
+            # a staged-but-not-yet-absorbed lane that gets parked (abort
+            # between staging and dispatch) must not arm into the next loop
+            self._staged_lane = None
 
     def _abandon_slot(self, slot: Slot, rollback: bool = False) -> None:
         """Free a slot whose request was aborted mid-flight: park its decode
@@ -2970,6 +3286,10 @@ class LLMEngine:
                 self._rollback_lane_session(slot)
             else:
                 self._drop_lane_session(slot)
+        if self._staged_lane == slot.idx:
+            # staged-but-unabsorbed lane dying on a fault path must not arm
+            # its stale shadow state into the next fused loop
+            self._staged_lane = None
         slot.request = None
         slot.pending_prompt = []
         slot.decoding = False
@@ -3022,7 +3342,10 @@ class LLMEngine:
                     for i in range(self.max_batch):
                         self._bt[i, :] = self._scratch_page(i)
         carry_lost = False
-        for arr in (self._dtok, self._dpos, self._dtemps, self._dtopk, self._dtopp):
+        for arr in (
+            self._dtok, self._dpos, self._dtemps, self._dtopk, self._dtopp,
+            self._dhist, self._dhlen,
+        ):
             try:
                 if arr.is_deleted():
                     carry_lost = True
@@ -3035,6 +3358,8 @@ class LLMEngine:
                 self._dtemps,
                 self._dtopk,
                 self._dtopp,
+                self._dhist,
+                self._dhlen,
             ) = self._alloc_carry()
             # fresh carry parks every lane at scratch: decoding requests
             # lost their device position and cannot continue
@@ -3043,6 +3368,27 @@ class LLMEngine:
                     self._fail_item(slot.request, RuntimeError("decode carry reset"))
                     self._reset_slot(slot)
                 slot.decoding = False
+        stage_lost = False
+        for arr in (
+            self._stok, self._spos, self._stemps, self._stopk, self._stopp,
+            self._shist, self._shlen,
+        ):
+            try:
+                if arr.is_deleted():
+                    stage_lost = True
+            except Exception:
+                stage_lost = True
+        if stage_lost:
+            (
+                self._stok,
+                self._spos,
+                self._stemps,
+                self._stopk,
+                self._stopp,
+                self._shist,
+                self._shlen,
+            ) = self._alloc_carry()
+            self._staged_lane = None
 
     def _do_restore(self, cmd: RestoreCmd) -> None:
         from .checkpoint import restore_kv_slot
@@ -3499,18 +3845,68 @@ class LLMEngine:
             jnp.asarray([req.top_k], jnp.int32),
             jnp.asarray([req.top_p], jnp.float32),
             greedy_cond=self.mesh is None,
+            approx_topk=self.approx_topk,
         )
+        hist_row = None
+        hist_n = 0
+        if self.inloop_spec:
+            # seed the in-loop drafter with the prompt tail, right-aligned;
+            # the inject scatter shifts it left one slot so the sampled
+            # first token occupies the newest position
+            ctx = req.prompt_ids[-(FUSED_HIST_W - 1):]
+            row = np.zeros((FUSED_HIST_W,), np.int32)
+            if ctx:
+                row[FUSED_HIST_W - len(ctx):] = ctx
+            hist_row = jnp.asarray(row)
+            hist_n = min(len(ctx) + 1, FUSED_HIST_W)
         # point the slot's decode lane at this prompt's continuation WITHOUT
         # waiting for the sampled token to reach the host — decode chunks
-        # chain from it on device; the value lands via the readback queue
-        self._inject_lane(
-            slot.idx,
-            first[0].astype(jnp.int32),
-            slot.position,
-            req.temperature,
-            req.top_k,
-            req.top_p,
+        # chain from it on device; the value lands via the readback queue.
+        # If a fused loop is already in flight and the staging slot is free,
+        # write the SHADOW carry instead: the pipelined next loop absorbs
+        # the lane at its entry (double-buffered injection) rather than
+        # waiting out an exit-and-redispatch.
+        use_stage = (
+            self.fused_decode
+            and self._fused_inject
+            and self._staged_lane is None
+            # host-side speculation reads the LIVE carry for verify rounds;
+            # a staged lane is invisible there until absorbed, so staging is
+            # only safe when spec runs in-loop (or not at all)
+            and (self.inloop_spec or not self._spec_active)
+            and any(e[0] == "fused" for e in self._readbacks)
         )
+        if use_stage:
+            self._stage_lane(
+                slot.idx,
+                first[0].astype(jnp.int32),
+                slot.position,
+                req.temperature,
+                req.top_k,
+                req.top_p,
+                hist_row,
+                hist_n,
+            )
+            self._staged_lane = slot.idx
+        else:
+            if (
+                self.fused_decode
+                and self._fused_inject
+                and any(e[0] == "fused" for e in self._readbacks)
+            ):
+                # staging slot occupied with a loop in flight: fall back to
+                # the direct-injection path (exit-and-redispatch semantics)
+                self.fused_inject_fallbacks_total += 1
+            self._inject_lane(
+                slot.idx,
+                first[0].astype(jnp.int32),
+                slot.position,
+                req.temperature,
+                req.top_k,
+                req.top_p,
+                hist_row,
+                hist_n,
+            )
         slot.dev_position = slot.position
         slot.decoding = True
         req.prefill_done_at = time.monotonic()
@@ -3661,62 +4057,86 @@ class LLMEngine:
 
     def _fused_dispatch(self) -> None:  # atp: hot
         """Dispatch one fused on-device decode loop (fused_decode=True's
-        replacement for _decode_dispatch): same snapshot/ladder/paged
-        pre-allocation discipline, but the compiled call is the
-        per-ladder-rung while_loop (_fused_fn) that masks finished lanes
-        and early-exits on device — the readback queued here is the loop's
-        single packed (tokens, lengths, reasons, steps) transfer. The loop
-        bound IS the ladder rung, so the admission contention story carries
-        over: contention shrinks the loop, newcomers' prefill still
-        preempts at rung boundaries. Speculation composes between fused
-        loops — _try_speculate runs its draft-verify bracket and falls
-        through here when no lane drafts."""
-        snapshot = [
+        replacement for _decode_dispatch): same snapshot/paged
+        pre-allocation discipline, but the compiled call is the dynamic-
+        rung while_loop (_fused_fn) that masks finished lanes, runs the
+        in-loop drafter/verifier, absorbs the staged injection lane, and
+        early-exits on device — the readback queued here is the loop's
+        single packed (tokens, lengths, reasons, steps, spec counters)
+        transfer. The loop bound ``nsteps`` is a runtime operand of ONE
+        compiled executable (_pick_fused_chunk), so the admission
+        contention story carries over — contention shrinks the loop,
+        newcomers' prefill still preempts at rung boundaries — without a
+        per-rung executable ladder. Host-side speculation composes between
+        fused loops when in-loop spec is off; with it on, drafting happens
+        inside the loop body and _try_speculate is bypassed."""
+        base = [
             (s, s.request, s.dev_position)
             for s in self.slots
             if s.decoding and s.request is not None
         ]
-        if not snapshot:
+        if not base:
             return
-        needed = max(r.max_tokens - r.dispatched for _, r, _ in snapshot)
+        needed = max(r.max_tokens - r.dispatched for _, r, _ in base)
         if needed <= 0:
             return
         # failpoint: same batch-wide seam as engine.decode_step, but its
         # own catalog name — chaos schedules can cut (or delay, for the
         # SIGKILL-mid-loop soak phase) exactly the fused path
-        if any(r.id for _, r, _ in snapshot):
+        if any(r.id for _, r, _ in base):
             faults.fire("engine.fused_decode")
-        # tail_shrink=False: budget tails stay on the top rung — the
-        # in-loop masks + early exit absorb the overshoot for free, one
-        # readback instead of the shrinking ladder's one-per-rung
-        chunk = self._pick_chunk(needed, tail_shrink=False)
+        chunk = self._pick_fused_chunk()
         if self.paged:
             kept = []
-            for s, r, p in snapshot:
+            for s, r, p in base:
                 try:
+                    # +FUSED_SPEC_K: the in-loop verifier forwards up to K
+                    # draft positions past the last real token; those writes
+                    # must land in owned pages even when rejected
                     self._ensure_lane_pages(
-                        s, min(p + chunk - 1, self.max_seq - 2), serving=bool(r.id)
+                        s,
+                        min(p + chunk + FUSED_SPEC_K, self.max_seq - 2),
+                        serving=bool(r.id),
                     )
                     kept.append((s, r, p))
                 except EngineOverloaded as e:
                     self._fail_item(r, e)
                     self._abandon_slot(s, rollback=True)
-            snapshot = kept
-            if not snapshot:
+            base = kept
+            if not base:
                 return
         self._rng, key = jax.random.split(self._rng)
-        keys = jax.random.split(key, chunk)
+        keys = jax.random.split(key, self._fused_cap)
         live = np.zeros((self.max_batch,), dtype=bool)
         budgets = np.zeros((self.max_batch,), dtype=np.int32)
         ign = np.zeros((self.max_batch,), dtype=bool)
-        for s, r, _ in snapshot:
+        armed = np.zeros((self.max_batch,), dtype=bool)
+        for s, r, _ in base:
             live[s.idx] = True
-            # chunk+1 cap: a lane with budget beyond this loop must NOT
-            # trip the in-loop budget check at the boundary — boundary
-            # finishes belong to the host scan, exactly like unfused
+            # chunk+1 emission cap: the most one loop can emit (spec can
+            # beat one-per-iteration). The device NEVER finishes on budget
+            # — cap-hit lanes freeze and the host rescan decides, so this
+            # estimate being ≥ true remaining (dispatched counts
+            # iterations, not emissions) is the safe direction
             budgets[s.idx] = min(r.max_tokens - r.dispatched, chunk + 1)
             ign[s.idx] = bool(r.ignore_eos)
-        packed, self._dtok, self._dpos, self.cache = self._fused_fn(chunk)(
+        if self._staged_lane is not None:
+            armed[self._staged_lane] = True
+        # per-lane upper bound on this loop's device-position advance —
+        # used for dev_position bookkeeping (paging must only ever
+        # over-ensure, never under)
+        snapshot = [(s, r, p, int(budgets[s.idx])) for s, r, p in base]
+        (
+            packed,
+            self._dtok,
+            self._dpos,
+            self._dtemps,
+            self._dtopk,
+            self._dtopp,
+            self._dhist,
+            self._dhlen,
+            self.cache,
+        ) = self._fused_fn()(
             self.params,
             self.cache,
             *self._bt_arg(),
@@ -3725,16 +4145,31 @@ class LLMEngine:
             self._dtemps,
             self._dtopk,
             self._dtopp,
+            self._dhist,
+            self._dhlen,
+            self._stok,
+            self._spos,
+            self._stemps,
+            self._stopk,
+            self._stopp,
+            self._shist,
+            self._shlen,
+            jnp.asarray(armed),
             jnp.asarray(live),
             jnp.asarray(budgets),
             jnp.asarray(ign),
             keys,
+            jnp.int32(chunk),
         )
-        for s, r, _ in snapshot:
-            # exact for unfinished lanes (they force the loop to run all
-            # `chunk` steps); finished lanes park at scratch on device and
-            # their host state is settled at processing (_process_fused)
-            s.dev_position += chunk
+        if self._staged_lane is not None:
+            # the loop just dispatched absorbs the staged lane at entry
+            self._staged_lane = None
+            self.fused_injections_total += 1
+        for s, r, _, adv in snapshot:
+            # upper bound for unfinished lanes; finished lanes park at
+            # scratch on device and their host state is settled (and
+            # dev_position corrected) at processing (_process_fused)
+            s.dev_position += adv
             r.dispatched += chunk
         self.fused_loops_total += 1
         self.decode_chunk_hist[chunk] = self.decode_chunk_hist.get(chunk, 0) + 1
@@ -3745,6 +4180,27 @@ class LLMEngine:
         except Exception:
             pass
         self._readbacks.append(("fused", snapshot, packed, chunk, time.monotonic()))
+
+    def _pick_fused_chunk(self) -> int:  # atp: hot
+        """Loop-bound policy for the fused dispatcher. ``nsteps`` is a
+        runtime operand (no per-rung executables), so the only tradeoff is
+        responsiveness: a longer loop amortizes dispatch/readback overhead
+        per token, a shorter one returns to admission/prefill work sooner.
+        Steady state rides the static cap (FUSED_RUNG_MULT × decode_chunk);
+        contention — a mid-prefill prompt or an admissible waiter — drops
+        to the smallest ladder rung, exactly like _pick_chunk. Budget tails
+        need no shrinking: per-lane caps freeze finished lanes and the
+        whole-batch early exit ends the loop the iteration everyone is
+        inactive."""
+        if not self.adaptive_decode:
+            return self.decode_chunk
+        contended = any(s.request is not None and s.pending_prompt for s in self.slots)
+        if not contended and (self._waiting or not self._queue.empty()):
+            contended = any(s.request is None for s in self.slots)
+        if contended and self._decode_ladder[0] < self._fused_cap:
+            self.decode_chunks_shrunk += 1
+            return self._decode_ladder[0]
+        return self._fused_cap
 
     def _pick_chunk(self, needed: int, tail_shrink: bool = True) -> int:
         """Adaptive decode-chunk policy (the admission-aware half of the
@@ -3866,6 +4322,7 @@ class LLMEngine:
                 bonus = sample_step(
                     row_a, k_bonus, temps, topk, topp,
                     greedy_cond=self.mesh is None,
+                    approx_topk=self.approx_topk,
                 ).astype(jnp.int32)
                 m = jnp.arange(K + 1, dtype=jnp.int32)[None, :]
                 shifted = jnp.concatenate(
@@ -4302,9 +4759,11 @@ class LLMEngine:
         was never fed: ``pending_last=True`` for every fused finish, and
         slot.position lands at start+used (no overshoot feed to roll back)."""
         _, snapshot, packed_dev, chunk, _ = entry
-        packed = np.asarray(packed_dev)  # [chunk+3, B]: tokens/counts/reasons/steps
+        cap_rows = self._fused_cap + 1
+        # [cap_rows+5, B]: tokens / counts / reasons / steps / nacc / ndr
+        packed = np.asarray(packed_dev)
         self.host_syncs_total += 1
-        steps = int(packed[chunk + 2, 0])
+        steps = int(packed[cap_rows + 2, 0])
         self.fused_steps_total += steps
         if steps < chunk:
             self.fused_early_exits_total += 1
@@ -4316,23 +4775,34 @@ class LLMEngine:
                 self.fused_exit_reason_hist.get("limit", 0) + 1
             )
         end = time.monotonic()
-        if self._last_decode_end is not None and steps:
-            self.itl_ms_recent.append(1000 * (end - self._last_decode_end) / steps)
+        # ITL per TOKEN, not per iteration: in-loop spec can emit several
+        # tokens per iteration, and the bench compares fused vs unfused on
+        # token cadence. The deepest lane's emission count is the loop's
+        # token depth; a loop whose lanes all went stale falls back to the
+        # iteration count.
+        depth = max(
+            (int(packed[cap_rows, s.idx]) for s, r, _, _ in snapshot if s.request is r),
+            default=0,
+        ) or steps
+        if self._last_decode_end is not None and depth:
+            self.itl_ms_recent.append(1000 * (end - self._last_decode_end) / depth)
         self._last_decode_end = end
         # HBM accounting happens here (not at dispatch) because the
         # executed step count is data-dependent: weights stream once per
         # while_loop iteration actually run, plus each lane's KV prefix
         self.hbm_bytes_read += steps * self.param_hbm_bytes + sum(
-            steps * (p + steps // 2) * self._kv_bytes_per_pos for _, _, p in snapshot
+            steps * (p + steps // 2) * self._kv_bytes_per_pos for _, _, p, _ in snapshot
         )
         eos = self.tokenizer.eos_id
-        for slot, req, start in snapshot:
+        for slot, req, start, _adv in snapshot:
             if slot.request is not req:
                 continue  # finished/aborted in an earlier (lagged) entry
             if not req.generated:
                 continue  # FIFO order puts the "first" entry before any loop
-            cnt = int(packed[chunk, slot.idx])
-            reason = int(packed[chunk + 1, slot.idx])
+            cnt = int(packed[cap_rows, slot.idx])
+            reason = int(packed[cap_rows + 1, slot.idx])
+            self.inloop_spec_accepted += int(packed[cap_rows + 3, slot.idx])
+            self.inloop_spec_drafted += int(packed[cap_rows + 4, slot.idx])
             outs = packed[:, slot.idx][:cnt]
             remaining = req.max_tokens - len(req.generated)
             used = 0
@@ -4347,14 +4817,16 @@ class LLMEngine:
             self.flops_done += used * self.cfg.flops_per_token(start + used // 2)
             finished = hit_eos or len(req.generated) >= req.max_tokens
             if finished:
-                # the loop never fed the finishing token (in-loop park):
-                # it is absent from KV — carried into the next turn's
-                # prompt, the same pending_last finish a boundary EOS takes
+                # the host scan is AUTHORITATIVE for budget finishes (the
+                # device only ever declares EOS; cap-hit lanes froze with
+                # reason 0). An EOS finish never fed its token (in-loop
+                # park) and a budget finish froze before feeding past its
+                # cap: pending_last=True either way, position at start+used
                 slot.position = start + used
                 self._finish(slot, pending_last=True)
             elif reason != 0:
                 # defensive: the device parked a lane the host scan wants
-                # to keep (cannot happen while budgets mirror remaining —
+                # to keep (cannot happen while ignore_eos policies agree —
                 # but a parked live lane would decode garbage at scratch
                 # forever, so re-point it at its last token explicitly)
                 slot.position = start + used
@@ -4368,7 +4840,19 @@ class LLMEngine:
                     req.top_p,
                 )
             else:
+                # live (or frozen-at-cap) lane: dev_position was advanced by
+                # the budget upper bound at dispatch; settle it to the REAL
+                # device position (start + cnt) plus the upper bounds of any
+                # still-in-flight loops that include this lane
                 slot.position = start + used
+                pending = sum(
+                    adv2
+                    for e in self._readbacks
+                    if e[0] == "fused"
+                    for s2, r2, _p2, adv2 in e[1]
+                    if s2 is slot and r2 is req
+                )
+                slot.dev_position = start + cnt + pending
 
 
 def _resolve(future: asyncio.Future, result: dict) -> None:

@@ -292,6 +292,8 @@ class LLMServeApp:
             ("prefix_cache", "ATPU_PREFIX_CACHE"),
             ("deadlines", "ATPU_DEADLINES"),
             ("fused_decode", "ATPU_FUSED_DECODE"),
+            ("inloop_spec", "ATPU_INLOOP_SPEC"),
+            ("approx_topk", "ATPU_APPROX_TOPK"),
         ):
             raw = os.environ.get(env_name)
             if raw is not None and flag not in opts:

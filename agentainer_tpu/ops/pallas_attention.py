@@ -35,6 +35,7 @@ ops/attention.py's reference implementation bit-for-bit in f32.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -302,18 +303,302 @@ def flash_attention_tpu(q, k, v, mask=None):
 
 
 # ---------------------------------------------------------------------------
-# paged (block-table) dispatch: pool [P, page_size, KV, hd] + block table
+# paged (block-table) attention: pool [P, page_size, KV, hd] + block table
 # ---------------------------------------------------------------------------
 #
-# v0 strategy: gather the lane's pages into a contiguous arena view, then
-# run the SAME flash kernels above — the gather is one XLA dynamic-gather
-# that XLA overlaps with the kernel launch, and the kernels stay the
-# single masking-rule implementation both layouts share. A fused Mosaic
-# kernel that walks the block table with scalar prefetch
-# (PrefetchScalarGridSpec) and DMAs pages HBM→VMEM directly slots in
-# HERE without touching any caller: these two functions are the dispatch
-# seam. On CPU CI the gather lowers to plain XLA and the reference path
-# in ops/attention.py runs instead — identical code, identical numerics.
+# Two implementations share the masking rule:
+#
+# - **Fused Mosaic kernel (TPU default).** The block table and query
+#   positions ride as scalar-prefetch operands (PrefetchScalarGridSpec), so
+#   each page's K/V block is DMA'd HBM→VMEM straight out of the pool at
+#   ``table[b, page]`` — the index_map IS the page walk; no gathered
+#   [B, S, KV, hd] arena copy ever materializes in HBM. The innermost grid
+#   dimension iterates logical pages and the online-softmax (m, l, acc)
+#   recurrence is identical to the dense kernels above with
+#   block_k == page_size.
+# - **Gather + dense flash (reference / fallback).** One XLA dynamic-gather
+#   into a contiguous arena view, then the dense kernels. CPU CI A/Bs the
+#   fused kernels (interpret=True) against this path bit-for-bit in f32
+#   (tests/test_pallas_attention.py); AGENTAINER_PAGED_GATHER=1 forces it
+#   on TPU for on-device A/B.
+#
+# ``paged_flash_prefill`` / ``paged_flash_decode`` remain the dispatch
+# seam: callers (ops/attention.py) never see which path ran.
+
+
+def _paged_prefill_kernel(
+    table_ref,  # [B, n_blocks] int32 (SMEM, scalar prefetch)
+    pos_ref,  # [1, bq, 1] int32           (VMEM)
+    q_ref,  # [1, 1, G, bq, hd]            (VMEM)
+    k_ref,  # [ps, hd] — the page at table[b, page]
+    v_ref,  # [ps, hd]
+    o_ref,  # [1, 1, G, bq, hd]
+    m_ref,  # [G, bq] f32 scratch
+    l_ref,  # [G, bq] f32 scratch
+    acc_ref,  # [G, bq, hd] f32 scratch
+    *,
+    groups: int,
+    page_size: int,
+    seq_len_k: int,
+    scale: float,
+):
+    ik = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    pos = pos_ref[0, :, 0]  # [bq] int32
+    k_start = ik * page_size
+    bq = pos.shape[0]
+    col = k_start + lax.broadcasted_iota(jnp.int32, (bq, page_size), 1)
+    mask = (col <= pos[:, None]) & (col < seq_len_k)  # [bq, ps]
+
+    # pages strictly in the future of every row in this q tile are skipped
+    @pl.when(k_start <= jnp.max(pos))
+    def _compute():
+        kb = k_ref[...].astype(jnp.float32)  # [ps, hd]
+        vb = v_ref[...].astype(jnp.float32)
+        col_valid = k_start + lax.broadcasted_iota(jnp.int32, (page_size, 1), 0)
+        vb = jnp.where(col_valid < seq_len_k, vb, 0.0)
+        for g in range(groups):
+            qb = q_ref[0, 0, g].astype(jnp.float32)  # [bq, hd]
+            s = lax.dot_general(
+                qb,
+                kb,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [bq, ps]
+            s = jnp.where(mask, s * scale, NEG_INF)
+            m_prev = m_ref[g, :]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new[:, None])
+            l_ref[g, :] = l_ref[g, :] * alpha + jnp.sum(p, axis=-1)
+            acc_ref[g] = acc_ref[g] * alpha[:, None] + lax.dot_general(
+                p,
+                vb,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_ref[g, :] = m_new
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        l = l_ref[...]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[...] / l[..., None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "interpret"))
+def fused_paged_flash_prefill(
+    q: jnp.ndarray,  # [B, T, H, hd]
+    pool_k: jnp.ndarray,  # [P, page_size, KV, hd]
+    pool_v: jnp.ndarray,
+    block_table: jnp.ndarray,  # [B, n_blocks] int32
+    q_positions: jnp.ndarray,  # [B, T] int32
+    block_q: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Paged prefill that walks the block table in the kernel grid: the
+    K/V index_map reads ``table[b, page]`` from scalar-prefetch SMEM, so
+    page blocks stream pool→VMEM with no gathered arena in between."""
+    b, t, h, hd = q.shape
+    ps, kv = pool_k.shape[1], pool_k.shape[2]
+    n_blocks = block_table.shape[1]
+    g = h // kv
+    bq = min(block_q, _round_up(t, 8))
+    seq_len_k = n_blocks * ps
+
+    qh = q.reshape(b, t, kv, g, hd).transpose(0, 2, 3, 1, 4)  # [B,KV,G,T,hd]
+
+    grid = (b, kv, pl.cdiv(t, bq), n_blocks)
+    kernel = functools.partial(
+        _paged_prefill_kernel,
+        groups=g,
+        page_size=ps,
+        seq_len_k=seq_len_k,
+        scale=1.0 / (hd**0.5),
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,  # the block table
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, 1), lambda ib, ih, iq, ik, tbl: (ib, iq, 0)),
+            pl.BlockSpec(
+                (1, 1, g, bq, hd),
+                lambda ib, ih, iq, ik, tbl: (ib, ih, 0, iq, 0),
+            ),
+            # the page walk: block index into the pool comes from the table
+            pl.BlockSpec(
+                (None, ps, None, hd),
+                lambda ib, ih, iq, ik, tbl: (tbl[ib, ik], 0, ih, 0),
+            ),
+            pl.BlockSpec(
+                (None, ps, None, hd),
+                lambda ib, ih, iq, ik, tbl: (tbl[ib, ik], 0, ih, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, g, bq, hd), lambda ib, ih, iq, ik, tbl: (ib, ih, 0, iq, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((g, bq), jnp.float32),
+            pltpu.VMEM((g, bq), jnp.float32),
+            pltpu.VMEM((g, bq, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(qh.shape, q.dtype),
+        interpret=interpret,
+    )(
+        block_table.astype(jnp.int32),
+        q_positions.astype(jnp.int32).reshape(b, t, 1),
+        qh,
+        pool_k,
+        pool_v,
+    )
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, t, h, hd)
+
+
+def _paged_decode_kernel(
+    table_ref,  # [B, n_blocks] int32 (SMEM, scalar prefetch)
+    pos_ref,  # [B] int32 (SMEM, scalar prefetch)
+    q_ref,  # [G, hd]
+    k_ref,  # [ps, hd] — the page at table[b, page]
+    v_ref,  # [ps, hd]
+    o_ref,  # [G, hd]
+    m_ref,  # [G, 1] f32
+    l_ref,  # [G, 1] f32
+    acc_ref,  # [G, hd] f32
+    *,
+    page_size: int,
+    seq_len_k: int,
+    scale: float,
+):
+    ip = pl.program_id(2)
+    npg = pl.num_programs(2)
+
+    @pl.when(ip == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    pos = pos_ref[pl.program_id(0)]
+    k_start = ip * page_size
+
+    @pl.when(k_start <= pos)
+    def _compute():
+        col = k_start + lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
+        mask = (col <= pos) & (col < seq_len_k)  # [1, ps]
+        qb = q_ref[...].astype(jnp.float32)  # [G, hd]
+        kb = k_ref[...].astype(jnp.float32)  # [ps, hd]
+        s = lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [G, ps]
+        s = jnp.where(mask, s * scale, NEG_INF)
+        m_prev = m_ref[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        vb = v_ref[...].astype(jnp.float32)
+        vb = jnp.where(col.reshape(page_size, 1) < seq_len_k, vb, 0.0)
+        l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=-1)
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:, 0] = m_new
+
+    @pl.when(ip == npg - 1)
+    def _finish():
+        l = l_ref[:, 0]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[...] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_paged_flash_decode(
+    q: jnp.ndarray,  # [B, H, hd]
+    pool_k: jnp.ndarray,  # [P, page_size, KV, hd]
+    pool_v: jnp.ndarray,
+    block_table: jnp.ndarray,  # [B, n_blocks] int32
+    q_positions: jnp.ndarray,  # [B] int32
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Single-token paged attention with the block-table walk fused into
+    the grid (block_k == page_size); pages past the lane's position are
+    skipped entirely — decode reads exactly the live pages from HBM."""
+    b, h, hd = q.shape
+    ps, kv = pool_k.shape[1], pool_k.shape[2]
+    n_blocks = block_table.shape[1]
+    g = h // kv
+    seq_len_k = n_blocks * ps
+
+    qh = q.reshape(b, kv, g, hd)
+
+    kernel = functools.partial(
+        _paged_decode_kernel,
+        page_size=ps,
+        seq_len_k=seq_len_k,
+        scale=1.0 / (hd**0.5),
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # block table + positions
+        grid=(b, kv, n_blocks),
+        in_specs=[
+            pl.BlockSpec(
+                (None, None, g, hd), lambda ib, ih, ip, tbl, pos: (ib, ih, 0, 0)
+            ),
+            pl.BlockSpec(
+                (None, ps, None, hd),
+                lambda ib, ih, ip, tbl, pos: (tbl[ib, ip], 0, ih, 0),
+            ),
+            pl.BlockSpec(
+                (None, ps, None, hd),
+                lambda ib, ih, ip, tbl, pos: (tbl[ib, ip], 0, ih, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (None, None, g, hd), lambda ib, ih, ip, tbl, pos: (ib, ih, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(qh.shape, q.dtype),
+        interpret=interpret,
+    )(
+        block_table.astype(jnp.int32),
+        q_positions.astype(jnp.int32),
+        qh,
+        pool_k,
+        pool_v,
+    )
+    return out.reshape(b, h, hd)
+
+
+def _fused_paged_enabled(page_size: int, head_dim: int) -> bool:
+    """The fused kernels need sublane-aligned pages and lane-aligned heads;
+    AGENTAINER_PAGED_GATHER=1 forces the gather reference for on-TPU A/B."""
+    if os.environ.get("AGENTAINER_PAGED_GATHER"):
+        return False
+    return (
+        jax.default_backend() == "tpu"
+        and page_size % 8 == 0
+        and head_dim % 128 == 0
+    )
 
 
 def paged_flash_prefill(
@@ -323,6 +608,10 @@ def paged_flash_prefill(
     block_table: jnp.ndarray,  # [B, n_blocks] int32
     q_positions: jnp.ndarray,  # [B, T] int32
 ) -> jnp.ndarray:
+    if _fused_paged_enabled(pool_k.shape[1], q.shape[-1]):
+        return fused_paged_flash_prefill(
+            q, pool_k, pool_v, block_table, q_positions
+        )
     from .attention import gather_pages  # deferred: attention.py imports us
 
     k, v = gather_pages(pool_k, pool_v, block_table)
@@ -336,6 +625,10 @@ def paged_flash_decode(
     block_table: jnp.ndarray,  # [B, n_blocks] int32
     q_positions: jnp.ndarray,  # [B] int32
 ) -> jnp.ndarray:
+    if _fused_paged_enabled(pool_k.shape[1], q.shape[-1]):
+        return fused_paged_flash_decode(
+            q, pool_k, pool_v, block_table, q_positions
+        )
     from .attention import gather_pages  # deferred: attention.py imports us
 
     k, v = gather_pages(pool_k, pool_v, block_table)

@@ -9,11 +9,18 @@ Three measurements:
 
   batch sweep — per-request decode ITL ((wall - TTFT) / (tokens - 1)) at
              batch 1 / 4 / max, ignore_eos so every lane runs its full
-             budget (fixed-length: the pure dispatch-overhead A/B). Host
-             syncs per token over this sweep must be no worse than the
-             per-chunk baseline (dispatch counts are arithmetically equal
-             at fixed length — both modes cover a budget tail in one
-             covering rung);
+             budget (fixed-length: the pure dispatch-overhead A/B). The
+             per-batch ``itl_ratio_fused_over_off_b{1,4,max}`` fields are
+             first-class artifact outputs, each with an explicit <= 1.0
+             acceptance bar: the dynamic-rung loop (one executable, nsteps
+             a runtime operand up to the fused cap) covers a request's
+             whole budget in a few long loops where the per-chunk baseline
+             pays dispatch + readback every decode_chunk steps. A second
+             sampled tier (temperature > 0) re-runs the mid batch through
+             the in-loop sampler — recorded as
+             ``itl_ratio_fused_over_off_sampled`` (no hard bar: sampling
+             cost is shared by both modes, the ratio is tracked for
+             drift);
   raw step — per-step wall of the bare jitted (forward + sample_step)
              body (cache donated, token fed back, best-of): the compute
              the loop repeats, with zero scheduling around it. The
@@ -85,15 +92,16 @@ def _decode_itl(r: dict, wall_ms: float):
     return (wall_ms - r["ttft_ms"]) / (r["completion_tokens"] - 1)
 
 
-async def _batch_pass(eng, batch: int) -> list[float]:
-    """One concurrent wave of ``batch`` fixed-length greedy requests."""
+async def _batch_pass(eng, batch: int, temperature: float = 0.0) -> list[float]:
+    """One concurrent wave of ``batch`` fixed-length requests."""
 
     async def one(i):
         t0 = time.monotonic()
         r = await eng.generate(
             f"decode loop lane {i}",
             max_tokens=MAX_TOKENS,
-            temperature=0.0,
+            temperature=temperature,
+            top_p=0.9 if temperature > 0 else 1.0,
             ignore_eos=True,
         )
         return _decode_itl(r, 1000 * (time.monotonic() - t0))
@@ -111,6 +119,13 @@ async def _sweep(eng) -> dict:
         s = sorted(itls)
         out[f"itl_ms_p50_b{b}"] = p50(itls)
         out[f"itl_ms_p99_b{b}"] = percentile(s, 0.99)
+    # sampled tier: temperature > 0 lanes exercise the full in-loop sampler
+    # (top-k/top-p filter + categorical draw per step) instead of the
+    # greedy argmax fast path
+    sampled: list[float] = []
+    for _ in range(PASSES):
+        sampled.extend(await _batch_pass(eng, min(4, MAX_BATCH), temperature=0.8))
+    out["itl_ms_p50_sampled"] = p50(sampled)
     return out
 
 
@@ -233,15 +248,21 @@ async def run() -> dict:
 
     raw = base.get("raw_step_ms")
     b1 = fused.get("itl_ms_p50_b1")
+
+    def _ratio(key: str):
+        f, o = fused.get(key), base.get(key)
+        return round(f / o, 3) if (f and o) else None
+
     out = {
         "metric": "llm_fused_decode_itl_p50_b1_over_raw_step",
         "value": round(b1 / raw, 3) if (b1 and raw) else None,
         "unit": "ratio",
-        "itl_ratio_fused_over_off_b1": (
-            round(b1 / base["itl_ms_p50_b1"], 3)
-            if (b1 and base.get("itl_ms_p50_b1"))
-            else None
-        ),
+        # first-class per-batch fused/off ITL ratios, each barred <= 1.0
+        **{
+            f"itl_ratio_fused_over_off_b{b}": _ratio(f"itl_ms_p50_b{b}")
+            for b in BATCHES
+        },
+        "itl_ratio_fused_over_off_sampled": _ratio("itl_ms_p50_sampled"),
         "syncs_per_token_fused": fused["host_syncs_per_token_fixed_len"],
         "syncs_per_token_off": base["host_syncs_per_token_fixed_len"],
         "eos_syncs_per_token_fused": eos_fused["host_syncs_per_token"],
@@ -263,20 +284,22 @@ async def run() -> dict:
 def main() -> None:
     out = asyncio.run(run())
     write_artifact("BENCH_decode_loop.json", out)
-    # acceptance guard (ISSUE 10): fused batch-1 decode ITL p50 within
-    # 1.2x of the raw per-step floor, AND host syncs per token strictly
-    # below the per-chunk baseline on the natural-EOS workload (early
-    # exit's stale-dispatch savings); fixed-length must never be worse
-    # (dispatch counts there are equal by arithmetic)
+    # acceptance guards: fused batch-1 decode ITL p50 within 1.2x of the
+    # raw per-step floor; fused ITL p50 no worse than the per-chunk
+    # baseline at EVERY batch size (the dynamic-rung loop must win, not
+    # merely amortize); host syncs per token strictly below baseline on
+    # the natural-EOS workload (early exit's stale-dispatch savings).
+    # The fixed-length sync ratio is recorded but NOT barred: dispatch
+    # counts there are equal by arithmetic, so the old <= guard could
+    # never fail — vacuous bars are worse than no bars.
+    ratios = [out[f"itl_ratio_fused_over_off_b{b}"] for b in BATCHES]
     ok = (
         out["value"] is not None
         and out["value"] <= 1.2
+        and all(r is not None and r <= 1.0 for r in ratios)
         and out["eos_syncs_per_token_fused"] is not None
         and out["eos_syncs_per_token_off"] is not None
         and out["eos_syncs_per_token_fused"] < out["eos_syncs_per_token_off"]
-        and out["syncs_per_token_fused"] is not None
-        and out["syncs_per_token_off"] is not None
-        and out["syncs_per_token_fused"] <= out["syncs_per_token_off"]
     )
     sys.exit(0 if ok else 1)
 

@@ -17,6 +17,10 @@ subprocesses — through deterministic fault phases:
                     subprocess (per-request isolation: the engine survives)
   llm_sigkill       SIGKILL the LLM host process, then token-identical
                     session resume from the KV snapshot
+  fused_inject      SIGKILL a fused+in-loop-spec engine while a second
+                    session's lane is STAGED into the running loop and the
+                    loop carries unverified device drafts: both journaled
+                    turns settle token-identical on the respawned engine
   replica_failover  2-replica LLM fleet: SIGKILL the replica serving a
                     session MID-DECODE; the journaled turn settles on the
                     SURVIVOR with a token-identical continuation (restored
@@ -549,6 +553,182 @@ class Soak:
         )
         return True
 
+    async def phase_fused_inject_resume(self, fid: str) -> bool:
+        """SIGKILL while a lane is being INJECTED into a running fused loop
+        that also holds unverified in-loop speculation state. A long
+        repetitive victim turn keeps the device n-gram drafter firing
+        (accepted drafts the host has NOT read back yet); 0.15 s in, a
+        second session's prefill stages itself into the running loop
+        (double-buffered lane injection); 0.15 s later the process is
+        SIGKILLed. Everything in flight — the armed staging slot, the
+        loop's packed readback, the drafted tokens — dies with the
+        process. Both journaled turns must settle COMPLETED on the
+        respawned engine token-identical to the controls, and the next
+        LIVE victim turn must match the control's bit for bit (extends
+        ``fused_resume_token_identical`` to the injection + in-loop-spec
+        composition)."""
+
+        async def turn(session: str, message: str, n: int = 32):
+            resp = await self.client.post(
+                f"/agent/{fid}/chat",
+                data=json.dumps(
+                    {
+                        "message": message,
+                        "session": session,
+                        "max_tokens": n,
+                        "ignore_eos": True,
+                    }
+                ),
+            )
+            doc = await resp.json()
+            rid = resp.headers.get("X-Agentainer-Request-ID", "")
+            return resp.status, doc.get("response", ""), rid
+
+        async def settle_identical(task, want: str, label: str) -> bool:
+            status, live, rid = await task
+            if status == 200:
+                if live != want:
+                    self.violations.append(
+                        f"fused_inject: live {label} diverged: {live!r} != {want!r}"
+                    )
+                    return False
+                return True
+            if not rid:
+                self.violations.append(
+                    f"fused_inject: {label} got {status} with no request id"
+                )
+                return False
+            deadline = time.monotonic() + RECOVERY_CAP_S
+            req = None
+            while time.monotonic() < deadline:
+                req = self.services.journal.get(fid, rid)
+                if req is not None and req.status == "completed":
+                    break
+                await asyncio.sleep(0.25)
+            if req is None or req.status != "completed":
+                self.violations.append(
+                    f"fused_inject: {label} never settled "
+                    f"({None if req is None else req.status})"
+                )
+                return False
+            import base64 as _b64
+
+            body = _b64.b64decode((req.response or {}).get("body_b64", "") or "")
+            try:
+                archived = json.loads(body).get("response", "")
+            except Exception:
+                archived = ""
+            if archived != want:
+                self.violations.append(
+                    f"fused_inject: archived {label} diverged: "
+                    f"{archived!r} != {want!r}"
+                )
+                return False
+            return True
+
+        engine_id = self.services.manager.get_agent(fid).engine_id
+        t_warm = time.monotonic()
+        while time.monotonic() - t_warm < 90.0:
+            stats = self.services.backend.stats(engine_id) or {}
+            if stats.get("model_loaded"):
+                break
+            await asyncio.sleep(0.5)
+        else:
+            self.violations.append("fused_inject: engine never loaded")
+            return False
+        if stats.get("fused_decode") is not True or stats.get("inloop_spec") is not True:
+            self.violations.append(
+                "fused_inject: agent is not serving fused decode + in-loop spec"
+            )
+            return False
+
+        # repetitive text keeps the trailing-n-gram drafter matching, so
+        # the loop is actually carrying accepted-draft state when killed
+        rep = "tick tock tick tock tick tock tick tock"
+        status, _, _ = await turn("fictl", rep)
+        assert status == 200, f"fused_inject ctl turn1 got {status}"
+        status, ctl_t2, _ = await turn("fictl", rep)
+        assert status == 200, f"fused_inject ctl turn2 got {status}"
+        status, ctl_t3, _ = await turn("fictl", "gamma", n=12)
+        assert status == 200, f"fused_inject ctl turn3 got {status}"
+        status, ctl_b, _ = await turn("fictl-b", "omega omega omega", n=12)
+        assert status == 200, f"fused_inject ctl lane-b got {status}"
+
+        status, _, _ = await turn("fivic", rep)
+        assert status == 200, f"fused_inject vic turn1 got {status}"
+        kv_key = f"agent:{fid}:kvcache:fivic"
+        t_snap = time.monotonic()
+        while self.services.store.get(kv_key) is None:
+            if time.monotonic() - t_snap > 45.0:
+                self.violations.append("fused_inject: KV snapshot never landed")
+                return False
+            await asyncio.sleep(0.25)
+
+        # fire the long victim turn, let its fused loop get in flight
+        # (>= one armed 150 ms dispatch), then fire the second session so
+        # its prefill stages into the RUNNING loop, then kill with both
+        # the staged lane and the loop's packed readback undelivered
+        t2_task = asyncio.ensure_future(turn("fivic", rep))
+        await asyncio.sleep(0.15)
+        tb_task = asyncio.ensure_future(turn("fivic-b", "omega omega omega", n=12))
+        await asyncio.sleep(0.15)
+        # sample the DOOMED engine's counters just before the kill: the
+        # respawned process starts from zero, so this is the only record
+        # of what was actually in flight when the SIGKILL landed
+        pre_kill = self.services.backend.stats(engine_id) or {}
+        t_kill = time.monotonic()
+        self.services.backend.kill_engine_hard(engine_id)
+        ok_a = await settle_identical(t2_task, ctl_t2, "vic turn2")
+        ok_b = await settle_identical(tb_task, ctl_b, "injected lane")
+        if not (ok_a and ok_b):
+            return False
+
+        # recovery probes on a THROWAWAY session (same reasoning as
+        # phase_fused_resume)
+        t0 = time.monotonic()
+        recovered = False
+        while time.monotonic() - t0 < RECOVERY_CAP_S:
+            s, _, _ = await turn("fiprobe", "ping", n=4)
+            if s == 200:
+                recovered = True
+                break
+            await asyncio.sleep(0.5)
+        self.mttr["fused_inject_sigkill"] = (
+            round(time.monotonic() - t_kill, 3) if recovered else -1.0
+        )
+        if not recovered:
+            self.violations.append("fused_inject: engine never served again")
+            return False
+        status, vic_t3, _ = await turn("fivic", "gamma", n=12)
+        if status != 200:
+            self.violations.append(f"fused_inject: vic turn3 got {status}")
+            return False
+        if vic_t3 != ctl_t3:
+            self.violations.append(
+                f"fused_inject: post-respawn turn diverged: "
+                f"{vic_t3!r} != {ctl_t3!r}"
+            )
+            return False
+        stats = (
+            self.services.backend.stats(
+                self.services.manager.get_agent(fid).engine_id
+            )
+            or {}
+        )
+        # pre-kill: what the dead process had absorbed (injections +
+        # staged arms + drafts in flight); post-respawn: the replayed
+        # turns' in-loop drafting on the fresh process
+        self.counts["fused_inject_injections_pre_kill"] = int(
+            pre_kill.get("fused_injections_total", 0) or 0
+        ) + int(pre_kill.get("fused_inject_fallbacks_total", 0) or 0)
+        self.counts["fused_inject_drafted_pre_kill"] = int(
+            pre_kill.get("inloop_spec_drafted", 0) or 0
+        )
+        self.counts["fused_inject_drafted"] = int(
+            stats.get("inloop_spec_drafted", 0) or 0
+        )
+        return True
+
     def _affine_replica(self, agent_id: str, session: str) -> str:
         """Which replica the router pinned a session to (the kill target)."""
         router = self.services.router
@@ -1004,6 +1184,32 @@ async def run_soak(tmpdir: str) -> dict:
             # greedy token stream is unchanged, the control holds.
             env={"ATPU_FAULTS": "engine.fused_decode:error=none,delay_ms=150"},
         )
+        fused_inject_id = await soak.deploy(
+            "chaos-fused-inject",
+            {
+                "engine": "llm",
+                "config": "tiny",
+                # fused loop WITH in-loop speculation (speculative on, so
+                # the device n-gram drafter runs inside the loop) and lane
+                # injection enabled: the composition whose in-flight state
+                # is the largest thing a SIGKILL can vaporize. Distinct
+                # options → its own host process.
+                "options": {
+                    "max_batch": 2,
+                    "max_seq": 256,
+                    "decode_chunk": 8,
+                    "prefill_chunk": 32,
+                    "kv_snapshot_interval_s": 0.5,
+                    "speculative": True,
+                    "fused_decode": True,
+                },
+            },
+            # same delay-only fused-dispatch failpoint as chaos-fused: each
+            # while_loop window takes >= 150 ms, so the staggered second
+            # session reliably stages into a RUNNING loop and the kill
+            # lands with that loop's readback undelivered
+            env={"ATPU_FAULTS": "engine.fused_decode:error=none,delay_ms=150"},
+        )
         paged_id = await soak.deploy(
             "chaos-paged",
             {
@@ -1032,15 +1238,26 @@ async def run_soak(tmpdir: str) -> dict:
         backpressured = await soak.phase_page_exhaustion(paged_id)
         token_identical = await soak.phase_llm_resume(llm_id)
         fused_identical = await soak.phase_fused_resume(fused_id)
+        inject_identical = await soak.phase_fused_inject_resume(fused_inject_id)
         lease_ok = await soak.phase_lease_flap(fleet_echo_id)
         route_ok = await soak.phase_route_dead(fleet_echo_id)
         failover_ok = await soak.phase_replica_failover(fleet_llm_id)
 
         inv = await soak.settle(
-            [echo_id, poison_id, paged_id, llm_id, fused_id, fleet_echo_id, fleet_llm_id]
+            [
+                echo_id,
+                poison_id,
+                paged_id,
+                llm_id,
+                fused_id,
+                fused_inject_id,
+                fleet_echo_id,
+                fleet_llm_id,
+            ]
         )
         inv["token_identical_resume"] = token_identical
         inv["fused_resume_token_identical"] = fused_identical
+        inv["fused_inject_resume_token_identical"] = inject_identical
         inv["page_exhaustion_backpressure"] = backpressured
         inv["lease_flap_recovers"] = lease_ok
         inv["route_dead_absorbed"] = route_ok

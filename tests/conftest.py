@@ -59,6 +59,32 @@ def _dump_stacks_on_hang():
     faulthandler.cancel_dump_traceback_later()
 
 
+# Every live XLA:CPU executable pins a handful of LLVM JIT mappings
+# (code/rodata/guard pages), and the tier-1 process compiles thousands of
+# programs across the suite — enough to cross vm.max_map_count (~65k), at
+# which point the next mmap inside LLVM fails and the process SEGFAULTS
+# mid-compile (observed at ~60k maps). Dropping executable references at a
+# module boundary once the map count nears the limit keeps the process
+# bounded; the persistent compilation cache above makes the resulting
+# recompiles cheap disk reads, not fresh XLA compiles.
+_MAP_GUARD = 40_000
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _jit_map_guard():
+    yield
+    try:
+        with open("/proc/self/maps") as f:
+            n = sum(1 for _ in f)
+    except OSError:
+        return
+    if n > _MAP_GUARD:
+        import gc
+
+        jax.clear_caches()
+        gc.collect()
+
+
 def _native_available() -> bool:
     try:
         from agentainer_tpu.native import available

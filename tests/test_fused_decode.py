@@ -206,6 +206,118 @@ def test_ignore_eos_honored_in_loop(base):
         b.shutdown()
 
 
+JSON_LOOP = '{"tool": "search", "args": {"q": "w", "n": 5}}\n' * 4
+
+
+def test_inloop_vs_hostside_spec_token_identical():
+    """ISSUE 17: the in-loop device drafter (n-gram match over the token
+    history carry, verified as a branch of the fused loop body) must emit
+    the SAME greedy stream as the host-side prompt-lookup drafter on
+    looping traffic — and actually draft (counters move) where the
+    traffic loops."""
+    host = LLMEngine.create(
+        "tiny",
+        options=dict(OPTS, fused_decode=True, speculative=True, inloop_spec=False),
+    )
+    dev = LLMEngine.create(
+        "tiny",
+        options=dict(OPTS, fused_decode=True, speculative=True, inloop_spec=True),
+    )
+    try:
+        assert host.inloop_spec is False
+        assert dev.inloop_spec is True
+        for prompt, n in ((JSON_LOOP, 24), ("plain prose prompt", 12)):
+            a = run(host.generate(prompt, max_tokens=n, temperature=0.0))
+            b = run(dev.generate(prompt, max_tokens=n, temperature=0.0))
+            assert b["tokens"] == a["tokens"]
+        m = dev.metrics()
+        assert m["inloop_spec"] is True
+        assert m["inloop_spec_drafted"] > 0
+        assert 0 <= m["inloop_spec_accepted"] <= m["inloop_spec_drafted"]
+        # the whole point: drafting without the host round-trip — the
+        # host-side spec counters must NOT move on the in-loop engine
+        assert m["spec_rounds"] == 0
+    finally:
+        host.shutdown()
+        dev.shutdown()
+
+
+def test_inloop_spec_matches_nonspec_greedy(base):
+    """Greedy bit-exactness of the in-loop drafter against the UNFUSED,
+    non-speculative reference (acceptance is argmax agreement, so drafts
+    can only ever reproduce the plain stream)."""
+    eng = LLMEngine.create(
+        "tiny", options=dict(OPTS, fused_decode=True, speculative=True)
+    )
+    try:
+        assert eng.inloop_spec is True
+        for prompt in (JSON_LOOP, "speculate then fuse"):
+            a = run(base.generate(prompt, max_tokens=14, temperature=0.0))
+            b = run(eng.generate(prompt, max_tokens=14, temperature=0.0))
+            assert b["tokens"] == a["tokens"]
+    finally:
+        eng.shutdown()
+
+
+def _staggered(eng, n_long=28, n_late=8):
+    """One long generation, then a late arrival that prefills while the
+    first lane's fused loops are in flight — the window the injection
+    staging slot exists for."""
+
+    async def body():
+        t1 = asyncio.create_task(
+            eng.generate("spin spin spin", max_tokens=n_long, temperature=0.0)
+        )
+        await asyncio.sleep(0.05)
+        t2 = asyncio.create_task(
+            eng.generate("late arrival", max_tokens=n_late, temperature=0.0)
+        )
+        return await asyncio.gather(t1, t2)
+
+    return run(body())
+
+
+def test_lane_injection_mid_loop_token_identical():
+    """ISSUE 17: absorbing a staged lane into a RUNNING fused loop must
+    produce exactly the token streams of the exit-and-redispatch path
+    (``_fused_inject`` toggled off) for both the established lane and the
+    injected one."""
+    inj = LLMEngine.create("tiny", options=dict(OPTS, fused_decode=True))
+    ref = LLMEngine.create("tiny", options=dict(OPTS, fused_decode=True))
+    ref._fused_inject = False  # force exit-and-redispatch for every lane
+    try:
+        for _ in range(6):
+            got = _staggered(inj)
+            want = _staggered(ref)
+            for w, g in zip(want, got):
+                assert g["tokens"] == w["tokens"]
+            if inj.metrics()["fused_injections_total"] > 0:
+                break
+        # the staging slot must have been exercised at least once across
+        # the staggered rounds (the loop retries to absorb scheduler jitter)
+        assert inj.metrics()["fused_injections_total"] > 0
+        assert ref.metrics()["fused_injections_total"] == 0
+    finally:
+        inj.shutdown()
+        ref.shutdown()
+
+
+def test_injection_disabled_engine_reports_zero():
+    """The `_fused_inject` kill-switch keeps every prefill on the direct
+    exit-and-redispatch injection; the staged-absorb counter must stay 0
+    and traffic must be unaffected."""
+    eng = LLMEngine.create("tiny", options=dict(OPTS, fused_decode=True))
+    eng._fused_inject = False
+    try:
+        got = _staggered(eng)
+        assert all(r["completion_tokens"] > 0 for r in got)
+        m = eng.metrics()
+        assert m["fused_injections_total"] == 0
+        assert m["fused_inject_fallbacks_total"] == 0
+    finally:
+        eng.shutdown()
+
+
 def test_snapshot_restore_token_identical():
     """Fused engine → snapshot → fresh fused engine → restore → continue:
     the continued stream equals the per-chunk pair doing the same dance

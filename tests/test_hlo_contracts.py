@@ -138,17 +138,19 @@ def test_engine_prefill_donation_actually_aliases(engine):
 
 
 def test_fused_loop_donation_survives_while_carry(engine):
-    """The fused decode loop donates (cache, tok, pos) THROUGH the
-    while_loop carry: both KV pool leaves must alias compiled outputs, or
-    every fused dispatch pays a full arena copy — silently erasing the
-    loop's entire HBM win."""
+    """The fused decode loop donates (cache, tok, pos, sampler params,
+    spec history) THROUGH the while_loop carry — including the in-loop
+    speculation cond branch: both KV pool leaves must alias compiled
+    outputs, or every fused dispatch pays a full arena copy — silently
+    erasing the loop's entire HBM win."""
     B = engine.max_batch
     live = jnp.zeros((B,), jnp.bool_)
     budgets = jnp.zeros((B,), jnp.int32)
     ign = jnp.zeros((B,), jnp.bool_)
-    keys = jax.random.split(jax.random.PRNGKey(0), 8)
+    armed = jnp.zeros((B,), jnp.bool_)
+    keys = jax.random.split(jax.random.PRNGKey(0), engine._fused_cap)
     hlo = (
-        engine._fused_fn(8)
+        engine._fused_fn()
         .lower(
             engine.params,
             engine.cache,
@@ -158,10 +160,21 @@ def test_fused_loop_donation_survives_while_carry(engine):
             engine._dtemps,
             engine._dtopk,
             engine._dtopp,
+            engine._dhist,
+            engine._dhlen,
+            engine._stok,
+            engine._spos,
+            engine._stemps,
+            engine._stopk,
+            engine._stopp,
+            engine._shist,
+            engine._shlen,
+            armed,
             live,
             budgets,
             ign,
             keys,
+            jnp.int32(8),
         )
         .compile()
         .as_text()
@@ -202,6 +215,22 @@ def test_recompile_budget_mixed_workload(engine):
         _gen(engine, PERSONA + "Name a color.", n=4, session="hc-b")
         # multi-turn on a resident paged session (block-table growth path)
         _gen(engine, "and another thing", n=4, session="hc-a")
+
+        # lane injection armed against a RUNNING fused loop: the staging
+        # merge is an operand (armed mask) of the same fused executable,
+        # and the fallback path reuses the jitted inject — zero compiles
+        # either way the race resolves
+        async def _staggered():
+            t1 = asyncio.ensure_future(
+                engine.generate(JSON_LOOP, max_tokens=24)
+            )
+            await asyncio.sleep(0.05)
+            t2 = asyncio.ensure_future(
+                engine.generate("late lane", max_tokens=6)
+            )
+            return await asyncio.gather(t1, t2)
+
+        asyncio.run(_staggered())
 
     # sanity: the families we budget over actually exist on this engine
     counts = compile_count(engine_jit_fns(engine))

@@ -10,8 +10,18 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from agentainer_tpu.ops.attention import attention_reference, cache_mask, causal_mask
-from agentainer_tpu.ops.pallas_attention import flash_decode, flash_prefill
+from agentainer_tpu.ops.attention import (
+    attention_reference,
+    cache_mask,
+    causal_mask,
+    gather_pages,
+)
+from agentainer_tpu.ops.pallas_attention import (
+    flash_decode,
+    flash_prefill,
+    fused_paged_flash_decode,
+    fused_paged_flash_prefill,
+)
 
 
 def _rand(key, *shape):
@@ -76,6 +86,101 @@ def test_decode_matches_reference(block_k):
         q[:, None], ck, cv, mask=cache_mask(positions[:, None], s)
     )[:, 0]
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# fused paged kernels: the block-table walk via scalar prefetch must agree
+# with the gather-then-flash reference path (the dispatch seam's other half)
+# on the exact same pool — including shared pages and ragged positions.
+
+
+def _paged_fixture(seed, b, nb, ps, kv, hd, n_pages):
+    keys = jax.random.split(jax.random.PRNGKey(seed), 3)
+    pool_k = _rand(keys[0], n_pages, ps, kv, hd)
+    pool_v = _rand(keys[1], n_pages, ps, kv, hd)
+    # non-trivial mapping: scrambled page ids, lane 0 and 1 SHARE page 7
+    # (paged prefix sharing) — the walk must not assume contiguity or
+    # exclusivity
+    table = np.array(
+        jax.random.permutation(keys[2], n_pages)[: b * nb], np.int32
+    ).reshape(b, nb)
+    if b >= 2:
+        table[0, 0] = 7
+        table[1, 0] = 7
+    return pool_k, pool_v, jnp.asarray(table)
+
+
+def test_fused_paged_decode_matches_gather_path():
+    b, heads, kv, hd, ps, nb = 3, 4, 2, 128, 16, 4
+    pool_k, pool_v, table = _paged_fixture(5, b, nb, ps, kv, hd, n_pages=16)
+    q = _rand(jax.random.PRNGKey(6), b, heads, hd)
+    positions = jnp.array([0, 30, 63], jnp.int32)
+
+    got = fused_paged_flash_decode(
+        q, pool_k, pool_v, table, positions, interpret=True
+    )
+    ck, cv = gather_pages(pool_k, pool_v, table)
+    want = flash_decode(q, ck, cv, positions, interpret=True)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+    ref = attention_reference(
+        q[:, None], ck, cv, mask=cache_mask(positions[:, None], nb * ps)
+    )[:, 0]
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_fused_paged_prefill_ragged_matches_gather_path():
+    """Chunked prefill at per-lane offsets (continuous batching): each lane
+    attends its own pages at its own position — the single masking rule,
+    now walked through the table."""
+    b, t, heads, kv, hd, ps, nb = 3, 16, 4, 2, 128, 16, 4
+    pool_k, pool_v, table = _paged_fixture(7, b, nb, ps, kv, hd, n_pages=16)
+    q = _rand(jax.random.PRNGKey(8), b, t, heads, hd)
+    offsets = jnp.array([0, 21, 48], jnp.int32)
+    positions = offsets[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+
+    got = fused_paged_flash_prefill(
+        q, pool_k, pool_v, table, positions, interpret=True
+    )
+    ck, cv = gather_pages(pool_k, pool_v, table)
+    want = flash_prefill(q, ck, cv, positions, interpret=True)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+    ref = attention_reference(q, ck, cv, mask=cache_mask(positions, nb * ps))
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_fused_paged_prefill_multiple_q_blocks():
+    b, t, heads, kv, hd, ps, nb = 1, 160, 4, 4, 128, 32, 8
+    pool_k, pool_v, table = _paged_fixture(9, b, nb, ps, kv, hd, n_pages=8)
+    q = _rand(jax.random.PRNGKey(10), b, t, heads, hd)
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+
+    got = fused_paged_flash_prefill(
+        q, pool_k, pool_v, table, positions, block_q=64, interpret=True
+    )
+    ck, cv = gather_pages(pool_k, pool_v, table)
+    want = attention_reference(q, ck, cv, mask=cache_mask(positions, nb * ps))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_fused_paged_decode_bf16():
+    b, heads, kv, hd, ps, nb = 2, 4, 2, 128, 16, 4
+    pool_k, pool_v, table = _paged_fixture(11, b, nb, ps, kv, hd, n_pages=16)
+    pool_k = pool_k.astype(jnp.bfloat16)
+    pool_v = pool_v.astype(jnp.bfloat16)
+    q = _rand(jax.random.PRNGKey(12), b, heads, hd).astype(jnp.bfloat16)
+    positions = jnp.array([15, 62], jnp.int32)
+
+    got = fused_paged_flash_decode(
+        q, pool_k, pool_v, table, positions, interpret=True
+    )
+    assert got.dtype == jnp.bfloat16
+    ck, cv = gather_pages(pool_k, pool_v, table)
+    want = attention_reference(
+        q[:, None], ck, cv, mask=cache_mask(positions[:, None], nb * ps)
+    )[:, 0]
+    np.testing.assert_allclose(
+        got.astype(np.float32), want.astype(np.float32), rtol=3e-2, atol=3e-2
+    )
 
 
 def test_decode_bf16():

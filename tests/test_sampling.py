@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from agentainer_tpu.engine.sampling import sample, sample_step
+from agentainer_tpu.engine.sampling import APPROX_SEG, sample, sample_step
 
 V = 8
 
@@ -117,6 +117,84 @@ def test_step_mixed_lane_batch():
         for lane, (t, k, p) in enumerate(lanes):
             want = sample(logits, kk, temperature=t, top_k=k, top_p=p)
             assert int(got[lane]) == int(want[lane]), (lane, i)
+
+
+# ---------------------------------------------------------------------------
+# approx_topk (segmented top-k via lax.approx_max_k): opt-in, exact is the
+# default. Greedy is untouched; within the segment it's bit-exact; past the
+# segment the filter is STRICTLY STRONGER than exact, which bounds divergence.
+
+
+def _step_approx(logits, key, t, k, p):
+    B = logits.shape[0]
+    return sample_step(
+        logits,
+        key,
+        jnp.full((B,), t, jnp.float32),
+        jnp.full((B,), k, jnp.int32),
+        jnp.full((B,), p, jnp.float32),
+        approx_topk=True,
+    )
+
+
+def test_approx_topk_greedy_unaffected():
+    logits = jax.random.normal(jax.random.PRNGKey(7), (4, V))
+    kk = jax.random.PRNGKey(8)
+    assert (
+        _step_approx(logits, kk, 0.0, 0, 1.0).tolist()
+        == _step(logits, kk, 0.0, 0, 1.0).tolist()
+    )
+
+
+def test_approx_topk_exact_when_vocab_fits_segment():
+    """V <= APPROX_SEG: the segment IS the full sorted vocab, so the
+    segmented path must be token-identical to the exact one."""
+    assert V <= APPROX_SEG
+    for t, k, p, seed in [(1.0, 3, 1.0, 1), (0.7, 4, 0.8, 2), (1.0, 0, 0.5, 3)]:
+        logits = jax.random.normal(jax.random.PRNGKey(seed), (4, V))
+        for i in range(16):
+            kk = jax.random.fold_in(jax.random.PRNGKey(11), i)
+            want = _step(logits, kk, t, k, p)
+            got = _step_approx(logits, kk, t, k, p)
+            assert got.tolist() == want.tolist(), (t, k, p, i)
+
+
+def _exact_kept(logits_np, k, p):
+    """The exact sampler's kept-token mask, recomputed independently."""
+    B, Vn = logits_np.shape
+    desc = np.sort(logits_np, -1)[:, ::-1]
+    keep = np.ones_like(logits_np, bool)
+    if k > 0:
+        kth = desc[:, min(k, Vn) - 1][:, None]
+        keep &= logits_np >= kth
+        desc = np.where(desc < kth, -1e30, desc)
+    if p < 1.0:
+        e = np.exp(desc - desc.max(-1, keepdims=True))
+        cum = np.cumsum(e / e.sum(-1, keepdims=True), -1)
+        cutoff_idx = (cum < p).sum(-1)
+        cutoff = np.take_along_axis(desc, cutoff_idx[:, None], -1)
+        keep &= logits_np >= cutoff
+    return keep
+
+
+def test_approx_topk_divergence_bounded_by_exact_filter():
+    """V > APPROX_SEG: every approx-sampled token must lie inside BOTH the
+    exact path's kept set (the segmented filter only ever drops more) and
+    the top-APPROX_SEG candidate set — the two halves of the documented
+    divergence bound."""
+    Vbig = APPROX_SEG * 2
+    logits = jax.random.normal(jax.random.PRNGKey(21), (4, Vbig)) * 3.0
+    lnp = np.asarray(logits)
+    seg_floor = np.sort(lnp, -1)[:, ::-1][:, APPROX_SEG - 1]
+    for t, k, p in [(1.0, 8, 1.0), (1.0, 0, 0.9), (0.8, 16, 0.7)]:
+        keep = _exact_kept(lnp, k, p)
+        for i in range(24):
+            kk = jax.random.fold_in(jax.random.PRNGKey(31), i)
+            got = np.asarray(_step_approx(logits, kk, t, k, p))
+            for b in range(lnp.shape[0]):
+                tok = int(got[b])
+                assert keep[b, tok], (t, k, p, i, b, tok)
+                assert lnp[b, tok] >= seg_floor[b], (t, k, p, i, b, tok)
 
 
 def test_step_mixed_lane_batch_jits_once():

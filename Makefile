@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all native test t1 test-native test-kernels bench overload spec decodeloop paged fleet chaos server dryrun verify clean analyze analyze-native
+.PHONY: all native test t1 test-native test-kernels bench overload spec decodeloop paged tiering fleet chaos server dryrun verify clean analyze analyze-native
 
 all: native
 
@@ -76,6 +76,13 @@ decodeloop:
 # gather/scatter attention path; writes BENCH_paged.json
 paged:
 	JAX_PLATFORMS=cpu $(PY) scripts/bench_paged.py
+
+# tiered KV hierarchy A/B (tiny model): context-retaining session capacity
+# at a fixed page-pool budget tiering on vs off, returning-turn TTFT for
+# parked sessions (never-parked control vs prewarmed vs cold promote),
+# and int8-vs-exact host-tier density; writes BENCH_tiering.json
+tiering:
+	JAX_PLATFORMS=cpu $(PY) scripts/bench_tiering.py
 
 # fleet bench (smoke): goodput + p99 TTFT at replicas 1/2/4 (echo), 2-replica
 # failover MTTR under steady probes, and mid-decode token-identical resume

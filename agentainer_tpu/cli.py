@@ -154,6 +154,11 @@ def cmd_deploy(args) -> None:
         # in (lax.approx_max_k segment, NOT bit-exact for sampled lanes),
         # --no-approx-topk pins the exact shared-sort sampler baseline
         option_overrides["approx_topk"] = bool(getattr(args, "approx_topk", False))
+    if getattr(args, "kv_tiering", False) or getattr(args, "no_kv_tiering", False):
+        # tiered KV hierarchy per deployment: --kv-tiering opts in (idle
+        # sessions park to pinned host RAM/store and promote on return),
+        # --no-kv-tiering pins the resident-only arena as the A/B baseline
+        option_overrides["kv_tiering"] = bool(getattr(args, "kv_tiering", False))
     if option_overrides:
         if isinstance(model, str):
             engine, _, config = model.partition(":")
@@ -540,6 +545,22 @@ def build_parser() -> argparse.ArgumentParser:
         help="pin this agent's engine to the exact shared-sort sampler "
         "(the default baseline) even when the fleet default "
         "features.approx_topk is on",
+    )
+    tiering_group = s.add_mutually_exclusive_group()
+    tiering_group.add_argument(
+        "--kv-tiering",
+        action="store_true",
+        help="enable the tiered KV hierarchy for this agent's engine "
+        "(idle sessions demote device → pinned host RAM → store and "
+        "promote back on their next turn; same as options.kv_tiering: "
+        "true in a deployment YAML)",
+    )
+    tiering_group.add_argument(
+        "--no-kv-tiering",
+        action="store_true",
+        help="pin this agent's engine to the resident-only KV arena "
+        "(the A/B baseline) even when the fleet default "
+        "features.kv_tiering is on",
     )
     s.add_argument("--health-endpoint", default="")
     s.add_argument("--health-interval", type=float, default=30.0)

@@ -72,6 +72,17 @@ class FeatureFlags:
     # full-vocab sort). Off by default: the exact shared-sort sampler is
     # the baseline; approx is opt-in and NOT bit-exact for sampled lanes.
     approx_topk: bool = False
+    # Default for the tiered KV hierarchy (device → pinned host RAM →
+    # store): idle sessions park off-device and promote back at their
+    # next turn, with pool-pressure demotion converting 429s into
+    # slower-but-served admissions. Off by default — tiering is the
+    # opt-in density lever; the resident-only arena is the A/B baseline.
+    kv_tiering: bool = False
+    # Proxy-side park linger: seconds an idle session must stay silent
+    # after its response settles before the proxy parks it off-device.
+    # Sized to agentic tool-call gaps — a tool round-trip inside the
+    # linger cancels the park; anything longer pays one prewarm instead.
+    tier_park_linger_s: float = 1.0
 
 
 @dataclass
@@ -418,4 +429,19 @@ def load_config(path: str | None = None) -> Config:
             "true",
             "yes",
         )
+    cfg.features.kv_tiering = bool(
+        feats.get("kv_tiering", cfg.features.kv_tiering)
+    )
+    if "ATPU_KV_TIERING" in env:
+        cfg.features.kv_tiering = env["ATPU_KV_TIERING"].lower() in (
+            "1",
+            "true",
+            "yes",
+        )
+    try:
+        cfg.features.tier_park_linger_s = float(
+            feats.get("tier_park_linger_s", cfg.features.tier_park_linger_s)
+        )
+    except (TypeError, ValueError):
+        pass  # malformed linger keeps the default; tiering still works
     return cfg

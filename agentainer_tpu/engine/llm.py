@@ -155,6 +155,19 @@ class PagePoolExhausted(EngineOverloaded):
         )
 
 
+class TierPromoteFailed(EngineOverloaded):
+    """Host-tier promotion failed (injected engine.kv_promote fault, or
+    the pool couldn't fit the swap-in even after pressure demotion): the
+    session STAYS parked — its context is preserved — and the triggering
+    turn surfaces as 429 + Retry-After, so a retry finds the session
+    still promotable. Subclasses EngineOverloaded for the same policy
+    mapping as genuine pool exhaustion."""
+
+    def __init__(self, session: str):
+        super().__init__(depth=0, watermark=0)
+        self.args = (f"KV tier promotion failed for session {session!r}",)
+
+
 class EngineDraining(RuntimeError):
     """SIGTERM drain in progress: no new admissions; in-flight work is
     being finished and sessions snapshotted before exit."""
@@ -235,6 +248,55 @@ class SnapshotCmd:
     session: str
     loop: asyncio.AbstractEventLoop
     future: asyncio.Future
+
+
+@dataclass
+class ParkCmd:
+    """Worker-queue command: demote an idle session's KV off the device
+    into the host RAM tier (kv_tiering). Resolves with the exact staged
+    (k, v, position, pending_token) host arrays — the caller packs them
+    into the store-durable SNAP_VERSION 3 blob (the cold tier) — or None
+    when the session is unknown/busy or the demote failpoint fired."""
+
+    session: str
+    loop: asyncio.AbstractEventLoop
+    future: asyncio.Future
+
+
+@dataclass
+class PrewarmCmd:
+    """Worker-queue command: promote a host-tier session back onto the
+    device AHEAD of its next turn (the proxy's next-arrival hint), so the
+    returning request admits against already-resident KV. Resolves True
+    when the session is device-resident afterwards."""
+
+    session: str
+    loop: asyncio.AbstractEventLoop
+    future: asyncio.Future
+
+
+@dataclass
+class TieredEntry:
+    """One parked session in the host RAM tier. ``k``/``v`` hold the
+    position-trimmed KV prefix as host numpy — either the cache's exact
+    dtype (tier_quantize=0) or int8 page tensors with per-page scales
+    (``k_scale``/``v_scale``; 2–4x density at a bounded rounding cost).
+    Self-speculation state parks with the KV so a promoted session drafts
+    exactly like one that never left the device."""
+
+    k: Any
+    v: Any
+    position: int
+    pending_token: int | None
+    nbytes: int
+    parked_at: float
+    quantized: bool = False
+    k_scale: Any = None
+    v_scale: Any = None
+    pages: int = 0
+    spec_hist: list[int] = field(default_factory=list)
+    spec_ema: float = 1.0
+    spec_miss: int = 0
 
 
 @dataclass
@@ -368,6 +430,8 @@ class LLMEngine:
         fused_decode: bool = False,
         inloop_spec: bool = True,
         approx_topk: bool = False,
+        kv_tiering: bool = False,
+        tier_quantize: int = 1,
     ):
         self.cfg = cfg
         self.tokenizer = tokenizer
@@ -626,6 +690,49 @@ class LLMEngine:
         self._restore_paged_fns: dict[int, Any] = {}
         self._page_copy_fn_cached: Any = None
 
+        # -- tiered KV hierarchy (device → pinned host RAM → store) -------
+        # Idle sessions park their KV OFF the device: a host-tier entry
+        # holds the position-trimmed prefix (exact dtype, or int8 with
+        # per-page scales when tier_quantize is on), the device pages flow
+        # back to the pool through the quarantine discipline, and the park
+        # also yields an exact SNAP_VERSION 3 blob for the store (the cold
+        # tier — survives the process). Promotion is the reverse and is
+        # initiated from the admission path so the device swap-in overlaps
+        # the queue-wait phase of TTFT. Works for BOTH arenas; the paged
+        # pool additionally demotes under pressure before 429ing.
+        self.kv_tiering = bool(kv_tiering)
+        self.tier_quantize = int(tier_quantize)
+        # _tier_lock guards _host_tier + byte/page gauges: API threads
+        # insert (park) while the worker promotes/pressure-demotes. Never
+        # held across device work or blocking readbacks.
+        self._tier_lock = threading.Lock()
+        self._host_tier: collections.OrderedDict[str, TieredEntry] = (
+            collections.OrderedDict()
+        )
+        # host-RAM budget for parked KV: beyond it the LRU host entries are
+        # dropped (their store blob remains — the cold tier serves the next
+        # turn via the serve layer's restore-on-unknown path). Defaults to
+        # one KV arena's worth of host RAM (stamped below, once the arena
+        # byte count is known).
+        self.tier_host_budget_bytes = 0
+        self.tier_host_bytes = 0
+        self.tier_quantized_pages = 0
+        self.tier_demotions_total = 0
+        self.tier_promotions_total = 0
+        self.tier_pressure_demotions_total = 0
+        self.tier_prewarm_hits_total = 0
+        self.tier_demote_failures_total = 0
+        self.tier_promote_failures_total = 0
+        self.tier_host_evictions_total = 0
+        self.tier_promote_overlap_ms_total = 0.0
+        # promote-start instants by session, consumed when the promoted
+        # session's next request dispatches its first prefill chunk — the
+        # interval is restore latency HIDDEN behind the queue-wait phase
+        self._tier_promote_started: dict[str, float] = {}
+        self.tier_promote_overlap_ms_recent: collections.deque[float] = (
+            collections.deque(maxlen=64)
+        )
+
         # Device-side decode carry: the pipelined decode chains (token,
         # position, temperature) per slot lane ON DEVICE across chunks, so
         # steady-state decode never waits for a host round-trip (the axon
@@ -795,6 +902,8 @@ class LLMEngine:
             x.nbytes for x in jax.tree.leaves(params)
         )
         self.kv_arena_bytes = cache.k.nbytes + cache.v.nbytes
+        if not self.tier_host_budget_bytes:
+            self.tier_host_budget_bytes = self.kv_arena_bytes
         # Cross-session prefix arena: bucket-length token prefixes → their
         # prefilled KV, populated the first time a prefix is prefilled and
         # forked into a fresh slot on admission (the second session with a
@@ -1022,6 +1131,8 @@ class LLMEngine:
                 fused_decode=bool(options.get("fused_decode", False)),
                 inloop_spec=bool(options.get("inloop_spec", True)),
                 approx_topk=bool(options.get("approx_topk", False)),
+                kv_tiering=bool(options.get("kv_tiering", False)),
+                tier_quantize=int(options.get("tier_quantize", 1) or 0),
             )
             if not options.get("skip_warmup"):
                 engine.warmup()
@@ -1153,6 +1264,8 @@ class LLMEngine:
             fused_decode=bool(options.get("fused_decode", False)),
             inloop_spec=bool(options.get("inloop_spec", True)),
             approx_topk=bool(options.get("approx_topk", False)),
+            kv_tiering=bool(options.get("kv_tiering", False)),
+            tier_quantize=int(options.get("tier_quantize", 1) or 0),
         )
         # pay the decode/prefill compiles here (inside the loader thread, while
         # /health keeps answering) instead of on the first user request.
@@ -2070,6 +2183,365 @@ class LLMEngine:
             fn = self._snap_fns[bucket] = jax.jit(_snap)
         return fn
 
+    # -- tiered KV hierarchy: device → pinned host RAM → store ------------
+    #
+    # Parking reuses the snapshot plane's staging fns (exact dtype, bounded
+    # shapes) and the pool's quarantine discipline for the freed pages;
+    # promotion reuses the restore fns. Tier transfers are pure data
+    # movement — no new compiled variants, ever (recompile budget 0).
+
+    async def park_session(self, session: str) -> bytes | None:
+        """Demote an idle session's KV off the device into the host RAM
+        tier and return its exact SNAP_VERSION 3 blob for the store (the
+        cold tier — survives the process and the host tier's LRU budget).
+        None: tiering off, session unknown/busy, or the demote failpoint
+        fired — in every case the session is left exactly as it was."""
+        if not self.kv_tiering:
+            return None
+        loop = asyncio.get_running_loop()
+        cmd = ParkCmd(session=session, loop=loop, future=loop.create_future())
+        self._queue.put(cmd)
+        staged = await cmd.future
+        if staged is None:
+            return None
+        k, v, position, pending_token = staged
+        from .checkpoint import pack_kv_snapshot
+
+        meta = {"session": session, "pending_token": pending_token}
+        if self.paged:
+            meta["page_size"] = self.page_size
+        return await asyncio.to_thread(pack_kv_snapshot, k, v, position, meta)
+
+    async def prewarm_session(self, session: str) -> bool:
+        """Promote a host-tier session back onto the device ahead of its
+        next turn (the proxy's next-arrival hint). True when the session
+        is device-resident afterwards (including already-resident)."""
+        if not self.kv_tiering:
+            return False
+        loop = asyncio.get_running_loop()
+        cmd = PrewarmCmd(session=session, loop=loop, future=loop.create_future())
+        self._queue.put(cmd)
+        return bool(await cmd.future)
+
+    def has_session(self, session: str) -> bool:
+        """Membership across tiers: device-resident OR parked in host RAM.
+        The serve layer asks this instead of ``in sessions`` so a parked
+        session is never mistaken for unknown (which would store-restore
+        stale context and re-prepend the system prompt — duplicated
+        context breaks resume parity)."""
+        if session in self.sessions:
+            return True
+        with self._tier_lock:
+            return session in self._host_tier
+
+    def _do_park(self, cmd: ParkCmd) -> None:
+        """Worker half of park_session: demote and hand the exact staged
+        host arrays back for the caller's store blob."""
+        staged = self._tier_demote(cmd.session) if self.kv_tiering else None
+        cmd.loop.call_soon_threadsafe(_resolve_value, cmd.future, staged)
+
+    def _do_prewarm(self, cmd: PrewarmCmd) -> None:
+        ok = self._tier_promote(cmd.session, prewarm=True) if self.kv_tiering else False
+        cmd.loop.call_soon_threadsafe(_resolve_value, cmd.future, ok)
+
+    def _tier_needs_promote(self, item) -> bool:
+        """Admission-path check: this request's session is parked in host
+        RAM and must swap in before _try_admit can see it."""
+        return (
+            self.kv_tiering
+            and isinstance(item, GenRequest)
+            and bool(item.session)
+            and item.session not in self.sessions
+            and item.session in self._host_tier
+        )
+
+    def _tier_demote(self, session: str, pressure: bool = False):
+        """Worker thread: stage an idle session's exact KV prefix to host,
+        free its device residency (pages via the quarantine discipline),
+        and insert the host-tier entry (int8 per-page-scale quantized when
+        tier_quantize is on). Returns the exact (k, v, position,
+        pending_token) host arrays on success — the store blob is packed
+        from THESE, before any quantization, so the cold tier keeps the
+        bit-exact resume guarantee — or None with the session untouched."""
+        try:
+            # failpoint: a failed demote means the session simply STAYS
+            # device-resident — parking is an optimization, never a
+            # correctness step
+            faults.fire("engine.kv_demote")
+        except Exception:
+            self.tier_demote_failures_total += 1
+            return None
+        if self.paged:
+            sess = self.paged_sessions.get(session)
+            if (
+                sess is None
+                or sess.lane is not None
+                or not sess.pages
+                or sess.position <= 0
+            ):
+                return None
+            count = min(len(sess.pages), (sess.position - 1) // self.page_size + 1)
+            ids = jnp.asarray(np.asarray(sess.pages[:count], dtype=np.int32))
+            k16, v16 = self._snap_fn_paged(count)(self.cache, ids)
+            # block on the gather BEFORE freeing the pages: the staged
+            # buffers are fresh arrays, but materializing them proves the
+            # read finished, so the freed pages can't be rewritten under it
+            k = np.asarray(k16)[:, : sess.position]
+            v = np.asarray(v16)[:, : sess.position]
+            position, pending = sess.position, sess.pending_token
+            spec = (list(sess.spec_hist), sess.spec_ema, sess.spec_miss)
+            with self._page_lock:
+                self._flush_parked_snapshot(session)
+                self._free_session_pages(sess)
+                self.paged_sessions.pop(session, None)
+                self.sessions.pop(session, None)
+        else:
+            idx = self.sessions.get(session)
+            if idx is None or idx < 0:
+                return None
+            slot = self.slots[idx]
+            if slot.request is not None or slot.position <= 0:
+                return None
+            k16, v16 = self._snap_fn(self._snap_bucket(slot.position))(
+                self.cache, jnp.int32(slot.idx)
+            )
+            k = np.asarray(k16)[:, : slot.position]
+            v = np.asarray(v16)[:, : slot.position]
+            position, pending = slot.position, slot.pending_token
+            spec = (list(slot.spec_hist), slot.spec_ema, slot.spec_miss)
+            self._flush_parked_snapshot(session)
+            self.sessions.pop(session, None)
+            slot.session = ""
+            slot.position = 0
+            slot.pending_token = None
+            slot.prefix_ctx = None
+            slot.spec_hist = []
+            slot.spec_ema = 1.0
+            slot.spec_miss = 0
+            slot.epoch += 1
+        if self.tier_quantize:
+            from .quant import quantize_kv_pages
+
+            qk, sk = quantize_kv_pages(k, self.page_size)
+            qv, sv = quantize_kv_pages(v, self.page_size)
+            entry = TieredEntry(
+                k=qk,
+                v=qv,
+                k_scale=sk,
+                v_scale=sv,
+                quantized=True,
+                pages=int(qk.shape[1]),
+                position=position,
+                pending_token=pending,
+                nbytes=qk.nbytes + qv.nbytes + sk.nbytes + sv.nbytes,
+                parked_at=time.monotonic(),
+                spec_hist=spec[0],
+                spec_ema=spec[1],
+                spec_miss=spec[2],
+            )
+        else:
+            entry = TieredEntry(
+                k=k,
+                v=v,
+                position=position,
+                pending_token=pending,
+                nbytes=k.nbytes + v.nbytes,
+                parked_at=time.monotonic(),
+                spec_hist=spec[0],
+                spec_ema=spec[1],
+                spec_miss=spec[2],
+            )
+        self._tier_insert_host(session, entry, pressure=pressure)
+        return k, v, position, pending
+
+    def _tier_drop_locked(self, session: str):
+        """Remove a host-tier entry + its gauge contribution. Caller holds
+        _tier_lock. Returns the entry (or None)."""
+        entry = self._host_tier.pop(session, None)
+        if entry is not None:
+            self.tier_host_bytes -= entry.nbytes
+            if entry.quantized:
+                self.tier_quantized_pages -= entry.pages
+        return entry
+
+    def _tier_insert_host(self, session: str, entry, pressure: bool = False) -> None:
+        with self._tier_lock:
+            self._tier_drop_locked(session)
+            self._host_tier[session] = entry
+            self._host_tier.move_to_end(session)
+            self.tier_host_bytes += entry.nbytes
+            if entry.quantized:
+                self.tier_quantized_pages += entry.pages
+            self.tier_demotions_total += 1
+            if pressure:
+                self.tier_pressure_demotions_total += 1
+            # host budget: LRU entries fall through to the store-only cold
+            # tier (their blob was written at park; the serve layer's
+            # restore-on-unknown path serves their next turn)
+            while (
+                self.tier_host_bytes > self.tier_host_budget_bytes
+                and len(self._host_tier) > 1
+            ):
+                oldest = next(iter(self._host_tier))
+                self._tier_drop_locked(oldest)
+                self.tier_host_evictions_total += 1
+
+    def _tier_promote(self, session: str, prewarm: bool = False) -> bool:
+        """Worker thread: swap a host-tier session back onto the device.
+        The restore dispatch is ASYNC (no readback) — called from the
+        admission path it overlaps the queue-wait phase of the returning
+        turn's TTFT. On failure the entry stays parked and False returns
+        (the admission path maps it to typed 429 backpressure)."""
+        with self._tier_lock:
+            entry = self._host_tier.get(session)
+        if entry is None:
+            return session in self.sessions
+        t0 = time.monotonic()
+        try:
+            faults.fire("engine.kv_promote")
+        except Exception:
+            self.tier_promote_failures_total += 1
+            return False
+        if entry.quantized:
+            from .quant import dequantize_kv_pages
+
+            k = dequantize_kv_pages(entry.k, entry.k_scale, entry.position)
+            v = dequantize_kv_pages(entry.v, entry.v_scale, entry.position)
+        else:
+            k, v = entry.k, entry.v
+        if self.paged:
+            ok = self._tier_promote_paged(session, entry, k, v)
+        else:
+            ok = self._tier_promote_dense(session, entry, k, v)
+        if not ok:
+            self.tier_promote_failures_total += 1
+            return False
+        with self._tier_lock:
+            self._tier_drop_locked(session)
+        self.tier_promotions_total += 1
+        if prewarm:
+            self.tier_prewarm_hits_total += 1
+        if len(self._tier_promote_started) > 256:
+            cutoff = t0 - 300.0
+            for name in [
+                n for n, t in self._tier_promote_started.items() if t < cutoff
+            ]:
+                self._tier_promote_started.pop(name, None)
+        self._tier_promote_started[session] = t0
+        return True
+
+    def _tier_promote_paged(self, session: str, entry, k, v) -> bool:
+        if entry.position <= 0 or entry.position >= self.max_seq - 1:
+            return False
+        if session in self.paged_sessions:
+            return True  # already resident (stale host entry; caller drops it)
+        count = (entry.position - 1) // self.page_size + 1
+        try:
+            ids = self._alloc_pages(count, serving=False)
+        except EngineOverloaded:
+            return False
+        k = np.asarray(k)
+        v = np.asarray(v)
+        pad = count * self.page_size - k.shape[1]
+        if pad:
+            widths = [(0, 0), (0, pad)] + [(0, 0)] * (k.ndim - 2)
+            k = np.pad(k, widths)
+            v = np.pad(v, widths)
+        dtype = self.cache.k.dtype
+        shape = (k.shape[0], count, self.page_size, *k.shape[2:])
+        self.cache = self._restore_fn_paged(count)(
+            self.cache,
+            jnp.asarray(np.asarray(ids, dtype=np.int32)),
+            jnp.asarray(k.reshape(shape), dtype),
+            jnp.asarray(v.reshape(shape), dtype),
+        )
+        sess = PagedSession(
+            name=session,
+            pages=ids,
+            position=entry.position,
+            pending_token=entry.pending_token,
+            last_used=time.monotonic(),
+            spec_hist=list(entry.spec_hist),
+            spec_ema=entry.spec_ema,
+            spec_miss=entry.spec_miss,
+        )
+        with self._page_lock:
+            self.paged_sessions[session] = sess
+            self.sessions[session] = -1
+        return True
+
+    def _tier_promote_dense(self, session: str, entry, k, v) -> bool:
+        from .checkpoint import restore_kv_slot
+
+        if entry.position <= 0 or entry.position >= self.max_seq - 1:
+            return False
+        slot = self._find_slot(session)
+        if slot is None:
+            return False
+        self.cache = restore_kv_slot(self.cache, slot.idx, k, v)
+        slot.position = entry.position
+        slot.pending_token = entry.pending_token
+        slot.last_used = time.monotonic()
+        slot.spec_hist = list(entry.spec_hist)
+        slot.spec_ema = entry.spec_ema
+        slot.spec_miss = entry.spec_miss
+        return True
+
+    def _tier_pressure_demote(self, need: int) -> None:
+        """Pool pressure (paged, worker thread, OUTSIDE _page_lock — the
+        staging readback blocks): demote idle resident sessions LRU-first
+        to the host tier until ``need`` pages are coverable. Where
+        _reclaim_pages destroys the victim's context, demotion preserves
+        it — a would-be 429 becomes a slower-but-served admission and the
+        victim's next turn promotes instead of re-prefilling."""
+        if not (self.kv_tiering and self.paged):
+            return
+
+        def short() -> bool:
+            with self._page_lock:
+                return len(self._page_free) + len(self._page_quarantine) < need
+
+        while short():
+            victim = None
+            with self._page_lock:
+                for sess in self.paged_sessions.values():
+                    if sess.lane is not None or not sess.pages or sess.position <= 0:
+                        continue
+                    if victim is None or sess.last_used < victim.last_used:
+                        victim = sess
+            if victim is None:
+                return
+            if self._tier_demote(victim.name, pressure=True) is None:
+                return  # demote failpoint or raced a new turn: stop, don't spin
+
+    def _tier_metrics(self) -> dict:
+        with self._tier_lock:
+            host_sessions = len(self._host_tier)
+            host_bytes = self.tier_host_bytes
+            quantized_pages = self.tier_quantized_pages
+        overlap = sorted(self.tier_promote_overlap_ms_recent)
+        return {
+            "kv_tiering": self.kv_tiering,
+            "tier_quantize": self.tier_quantize,
+            "tier_host_sessions": host_sessions,
+            "tier_host_bytes": host_bytes,
+            "tier_host_budget_bytes": self.tier_host_budget_bytes,
+            "tier_quantized_pages": quantized_pages,
+            "tier_demotions_total": self.tier_demotions_total,
+            "tier_promotions_total": self.tier_promotions_total,
+            "tier_pressure_demotions_total": self.tier_pressure_demotions_total,
+            "tier_prewarm_hits_total": self.tier_prewarm_hits_total,
+            "tier_demote_failures_total": self.tier_demote_failures_total,
+            "tier_promote_failures_total": self.tier_promote_failures_total,
+            "tier_host_evictions_total": self.tier_host_evictions_total,
+            "tier_promote_overlap_ms_total": round(
+                self.tier_promote_overlap_ms_total, 2
+            ),
+            "tier_promote_overlap_ms_p50": (
+                round(overlap[len(overlap) // 2], 2) if overlap else None
+            ),
+        }
+
     # -- paged arena: page allocator + block tables -----------------------
     #
     # Host-side bookkeeping for the device page pool. The free list /
@@ -2115,6 +2587,17 @@ class LLMEngine:
                     free = len(self._page_free)
                 raise PagePoolExhausted(n, free) from e
         self._reap_quarantine_if_short(n)
+        if reclaim and self.kv_tiering:
+            with self._page_lock:
+                tier_short = (
+                    len(self._page_free) + len(self._page_quarantine) < n
+                )
+            if tier_short:
+                # demote idle residents to the HOST TIER before destructive
+                # reclaim: parked context survives for its next turn, and
+                # the freed pages convert a would-be 429 into admission
+                self._tier_pressure_demote(n)
+                self._reap_quarantine_if_short(n)
         with self._page_lock:
             if len(self._page_free) < n and reclaim:
                 self._reclaim_pages(n)
@@ -2654,6 +3137,12 @@ class LLMEngine:
         """Drop idle sessions (all, or only those whose name starts with
         ``prefix`` — a multi-tenant host clears one tenant's namespace
         without touching its co-tenants' KV)."""
+        if self.kv_tiering:
+            # host-tier entries are sessions too: clearing must not leave
+            # a parked copy that the next same-named session promotes
+            with self._tier_lock:
+                for name in [s for s in self._host_tier if s.startswith(prefix)]:
+                    self._tier_drop_locked(name)
         if self.paged:
             with self._page_lock:
                 for name in [s for s in self.paged_sessions if s.startswith(prefix)]:
@@ -2810,6 +3299,10 @@ class LLMEngine:
             # sessions are bounded by pages, not max_batch, so capacity
             # questions are answered here, not by active_sessions alone
             **self._paged_metrics(),
+            # tiered KV hierarchy: per-tier session counts, host-tier
+            # bytes/quantized pages, demote/promote/prewarm totals, and the
+            # promote-overlap hidden-ms — the capacity claim's gauges
+            **self._tier_metrics(),
             # raw append-ordered samples (bounded deques): lets a caller
             # window percentiles over ITS measurement interval instead of
             # whatever warmup/compile history the deque still holds
@@ -3057,8 +3550,20 @@ class LLMEngine:
                     self._do_restore(item)
                 elif isinstance(item, SnapshotCmd):
                     self._do_snapshot(item)
+                elif isinstance(item, ParkCmd):
+                    self._do_park(item)
+                elif isinstance(item, PrewarmCmd):
+                    self._do_prewarm(item)
                 elif self._pre_reject(item):
                     pass  # expired/cancelled before prefill — already failed
+                elif self._tier_needs_promote(item) and not self._tier_promote(
+                    item.session
+                ):
+                    # host-parked session whose device swap-in failed
+                    # (injected kv_promote fault or pool pressure): typed
+                    # backpressure — the entry stays parked, a retry finds
+                    # the session still promotable
+                    raise TierPromoteFailed(item.session)
                 elif not self._try_admit(item):
                     still.append(item)
             except EngineOverloaded as e:
@@ -3783,6 +4288,18 @@ class LLMEngine:
             self.admission_ms_recent.append(
                 1000 * (req.prefill_started_at - req.submitted_at)
             )
+            # promote-overlap accounting: the interval from the tier
+            # promotion's start to this first prefill dispatch is restore
+            # latency HIDDEN behind the queue-wait phase of TTFT
+            t0 = (
+                self._tier_promote_started.pop(req.session, None)
+                if req.session
+                else None
+            )
+            if t0 is not None:
+                hidden = 1000 * (req.prefill_started_at - t0)
+                self.tier_promote_overlap_ms_total += hidden
+                self.tier_promote_overlap_ms_recent.append(hidden)
         chunk = slot.pending_prompt[: self.prefill_chunk]
         slot.pending_prompt = slot.pending_prompt[self.prefill_chunk :]
         final = not slot.pending_prompt

@@ -110,6 +110,11 @@ class LLMServeApp:
         self._host_token = E.get("AGENTAINER_HOST_TOKEN", "")
         self.kv_restores = 0
         self.prefix_prewarms = 0
+        # tiered KV hierarchy (kv_tiering): proxy-hinted park/prewarm ops
+        self.kv_parks = 0
+        self.kv_park_errors = 0
+        self.kv_prewarms = 0
+        self.kv_prewarm_errors = 0
         self.kv_snapshots = 0
         self.kv_snapshots_deferred = 0
         self.kv_snapshot_errors = 0
@@ -294,6 +299,7 @@ class LLMServeApp:
             ("fused_decode", "ATPU_FUSED_DECODE"),
             ("inloop_spec", "ATPU_INLOOP_SPEC"),
             ("approx_topk", "ATPU_APPROX_TOPK"),
+            ("kv_tiering", "ATPU_KV_TIERING"),
         ):
             raw = os.environ.get(env_name)
             if raw is not None and flag not in opts:
@@ -486,6 +492,10 @@ class LLMServeApp:
         app.router.add_post("/clear", self.h_clear)
         app.router.add_get("/metrics", self.h_metrics)
         app.router.add_post("/profile", self.h_profile)
+        # tiered KV hierarchy: the proxy's park/prewarm hints ride the same
+        # dispatch path as /chat (journal/fleet semantics apply unchanged)
+        app.router.add_post("/park", self.h_park)
+        app.router.add_post("/prewarm", self.h_prewarm)
         if self._host_token:
             # multi-tenant host admin surface (localhost-only process; the
             # backend authenticates with the host token it minted at spawn)
@@ -773,8 +783,10 @@ class LLMServeApp:
 
         # crash-resume: an unknown session may have a KV snapshot in the
         # store from a previous engine life — restore it before generating
-        # so the conversation continues from its exact context
-        if self.store.connected and self._sess(session) not in self.engine.sessions:
+        # so the conversation continues from its exact context. A session
+        # parked in the engine's host tier is KNOWN (it promotes at
+        # admission) — store-restoring it would resurrect stale context.
+        if self.store.connected and not self._engine_has_session(session):
             try:
                 blob = await self.store.get_bytes(self._kv_key(session))
                 if blob:
@@ -789,7 +801,7 @@ class LLMServeApp:
         # with the system prompt; later turns inherit it through the KV
         # cache. Only the raw user message goes to /history.
         prompt = message
-        if self.system_prompt and self._sess(session) not in self.engine.sessions:
+        if self.system_prompt and not self._engine_has_session(session):
             prompt = f"{self.system_prompt}\n\n{message}"
 
         try:
@@ -823,6 +835,88 @@ class LLMServeApp:
                 "ttft_breakdown": result.get("ttft_breakdown"),
             }
         )
+
+    def _engine_has_session(self, session: str) -> bool:
+        """Cross-tier membership: device-resident or parked in the host
+        tier. getattr-guarded so duck-typed engine doubles (echo engine,
+        test fakes) that only expose ``sessions`` keep working."""
+        name = self._sess(session)
+        has = getattr(self.engine, "has_session", None)
+        if has is not None:
+            return bool(has(name))
+        return name in self.engine.sessions
+
+    async def h_park(self, request: web.Request) -> web.Response:
+        """Tiering hint: demote an idle session off the device (proxy
+        policy calls this after a response settles + linger). The exact
+        staged blob is persisted to the store as the COLD tier — a parked
+        session survives both the host tier's LRU budget and the process."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        session = str(body.get("session", "default"))
+        park = getattr(self.engine, "park_session", None)
+        if park is None or not getattr(self.engine, "kv_tiering", False):
+            return web.json_response({"parked": False, "reason": "tiering off"})
+        try:
+            blob = await park(self._sess(session))
+        except Exception as e:
+            self.kv_park_errors += 1
+            return web.json_response(
+                {"parked": False, "reason": f"{type(e).__name__}: {e}"}
+            )
+        if blob is None:
+            return web.json_response({"parked": False, "reason": "unknown or busy"})
+        self.kv_parks += 1
+        if self.store.connected:
+            try:
+                await self.store.set_bytes(self._kv_key(session), blob, ttl=24 * 3600)
+                self._kv_last_snap[session] = time.monotonic()
+            except Exception as e:
+                # host tier still holds the session; only store durability
+                # degraded — counted, not fatal
+                self.kv_park_errors += 1
+                print(
+                    f"[llm-serve] park store write failed: {type(e).__name__}: {e}",
+                    flush=True,
+                )
+        return web.json_response({"parked": True, "bytes": len(blob)})
+
+    async def h_prewarm(self, request: web.Request) -> web.Response:
+        """Tiering hint: promote a parked session back onto the device
+        ahead of its next turn (proxy next-arrival hint). Falls back to a
+        store restore when the session fell through to the cold tier."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        session = str(body.get("session", "default"))
+        prewarm = getattr(self.engine, "prewarm_session", None)
+        if prewarm is None or not getattr(self.engine, "kv_tiering", False):
+            return web.json_response({"prewarmed": False, "reason": "tiering off"})
+        ok = False
+        try:
+            ok = bool(await prewarm(self._sess(session)))
+        except Exception:
+            # best-effort hint: counted; admission still promotes later
+            self.kv_prewarm_errors += 1
+        if not ok and self.store.connected:
+            # cold tier: the host entry was LRU-dropped (or never existed);
+            # the store blob restores the exact context instead
+            try:
+                blob = await self.store.get_bytes(self._kv_key(session))
+                if blob:
+                    ok = bool(
+                        await self.engine.restore_session(self._sess(session), blob)
+                    )
+                    if ok:
+                        self.kv_restores += 1
+            except Exception:
+                self.kv_prewarm_errors += 1
+        if ok:
+            self.kv_prewarms += 1
+        return web.json_response({"prewarmed": ok})
 
     async def _record_turn(self, session: str, message: str, reply: str) -> None:
         now = time.time()
@@ -1022,6 +1116,10 @@ class LLMServeApp:
             "kv_snapshots_deferred": self.kv_snapshots_deferred,
             "kv_restores": self.kv_restores,
             "prefix_prewarms": self.prefix_prewarms,
+            "kv_parks": self.kv_parks,
+            "kv_park_errors": self.kv_park_errors,
+            "kv_prewarms": self.kv_prewarms,
+            "kv_prewarm_errors": self.kv_prewarm_errors,
             "kv_snapshot_errors": self.kv_snapshot_errors,
             "last_kv_snapshot_error": self.last_kv_snapshot_error or None,
             "unhandled_errors": self.unhandled_errors,

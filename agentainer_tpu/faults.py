@@ -124,6 +124,12 @@ CATALOG: frozenset[str] = frozenset(
         "engine.fused_decode",
         "engine.snapshot",
         "engine.page_alloc",
+        # tiered KV hierarchy: a firing kv_demote leaves the session
+        # device-resident (parking is an optimization); a firing kv_promote
+        # keeps the session parked and the triggering turn 429s typed —
+        # context is preserved and a retry recovers
+        "engine.kv_demote",
+        "engine.kv_promote",
         "watcher.respawn",
         # fleet seams: the routing tier's replica choice (firing = a stale
         # routing table hands back a dead replica), the replica heartbeat

@@ -392,6 +392,7 @@ class ReplicaMonitor:
                 )
                 self.lease_refreshes_total += 1
                 self._transition(agent, engine_id, REPLICA_ALIVE, now)
+                self._feed_router_load(engine_id)
                 return
             except Exception:
                 # refresh failed (store blip or injected lease fault): the
@@ -418,6 +419,30 @@ class ReplicaMonitor:
             self._transition(agent, engine_id, REPLICA_SUSPECT, now)
         # else: lease still fresh — keep the current state (a single missed
         # probe inside the suspect window is not an event)
+
+    def _feed_router_load(self, engine_id: str) -> None:
+        """Push the replica's ENGINE-reported occupancy to the router's
+        p2c signal: queue depth + waiting lanes + active lanes from the
+        engine's own /metrics. The proxy-side in-flight count only sees
+        this proxy's dispatches; the engine's admission picture also
+        counts journal replays and lanes still decoding after their HTTP
+        response settled. Best-effort: a failed sample keeps the router
+        on its previous value (or the in-flight fallback)."""
+        if self.router is None:
+            return
+        try:
+            stats = self.manager.backend.stats(engine_id)
+            if not stats:
+                return
+            depth = (
+                int(stats.get("queue_depth", 0) or 0)
+                + int(stats.get("waiting_depth", 0) or 0)
+                + int(stats.get("active_requests", 0) or 0)
+            )
+            self.router.set_load(engine_id, depth)
+        except Exception:
+            # a malformed sample must not fail the probe pass (counted)
+            self.probe_errors_total += 1
 
     def _lease_at(self, agent_id: str, engine_id: str) -> tuple[bool, float | None]:
         """(read_ok, lease timestamp | None). ok=False means the store

@@ -193,6 +193,34 @@ class MetricsPlane:
                             "page_exhausted_total", 0
                         ),
                     }
+                # tiered-KV rollup: "where do this agent's sessions live"
+                # — resident on device vs parked in host RAM (and how much
+                # of that is int8), plus the tier-transfer traffic and how
+                # much restore latency the prewarm overlap actually hid
+                if engine_stats.get("kv_tiering"):
+                    sample["kv_tiering"] = {
+                        "enabled": True,
+                        "host_sessions": engine_stats.get("tier_host_sessions", 0),
+                        "host_bytes": engine_stats.get("tier_host_bytes", 0),
+                        "quantized_pages": engine_stats.get(
+                            "tier_quantized_pages", 0
+                        ),
+                        "demotions_total": engine_stats.get(
+                            "tier_demotions_total", 0
+                        ),
+                        "promotions_total": engine_stats.get(
+                            "tier_promotions_total", 0
+                        ),
+                        "pressure_demotions_total": engine_stats.get(
+                            "tier_pressure_demotions_total", 0
+                        ),
+                        "prewarm_hits_total": engine_stats.get(
+                            "tier_prewarm_hits_total", 0
+                        ),
+                        "promote_overlap_ms_p50": engine_stats.get(
+                            "tier_promote_overlap_ms_p50"
+                        ),
+                    }
                 # deadline/overload rollup: one place answering "is this
                 # agent dropping work, and where" — proxy-side sheds (this
                 # sample's proxy.shed) plus the engine's lifetime policy

@@ -176,6 +176,18 @@ class ControlPlaneApp:
         self.journal_errors_total = 0
         self.journal_skipped_total = 0
         self.abort_cancel_errors_total = 0
+        # tiered-KV proxy policy (features.kv_tiering): the proxy SEES the
+        # agent's conversation — it parks a session after its response
+        # settles (plus a linger window for fast tool-call round-trips)
+        # and prewarms on the next arrival so the engine's swap-in
+        # overlaps the queue-wait phase. Hints ride dispatch_to_agent, so
+        # fleet routing/affinity semantics apply to them unchanged.
+        self._tier_parked: set[tuple[str, str]] = set()
+        self._tier_linger_tasks: dict[tuple[str, str], asyncio.Task] = {}
+        self._tier_bg: set[asyncio.Task] = set()
+        self.tier_parks_total = 0
+        self.tier_park_failures_total = 0
+        self.tier_prewarms_total = 0
         self.app.on_startup.append(self._on_startup)
         self.app.on_cleanup.append(self._on_cleanup)
 
@@ -734,6 +746,10 @@ class ControlPlaneApp:
                 "journal_errors_total": self.journal_errors_total,
                 "journal_skipped_total": self.journal_skipped_total,
                 "abort_cancel_errors_total": self.abort_cancel_errors_total,
+                "tier_parks_total": self.tier_parks_total,
+                "tier_park_failures_total": self.tier_park_failures_total,
+                "tier_prewarms_total": self.tier_prewarms_total,
+                "tier_parked_sessions": len(self._tier_parked),
             }
         )
 
@@ -1057,6 +1073,12 @@ class ControlPlaneApp:
                 )
             return fail("agent is not running", status=503)
 
+        if self._tier_enabled() and path.startswith("/chat"):
+            # returning turn: fire the prewarm hint BEFORE the chat dispatch
+            # so the engine's host→device swap-in overlaps this request's
+            # own queue wait (the TTFT admission phase hides the restore)
+            self._tier_on_arrival(agent_id, self._session_hint(body) or "default")
+
         dispatch = asyncio.ensure_future(
             self.dispatch_to_agent(
                 agent_id,
@@ -1132,6 +1154,10 @@ class ControlPlaneApp:
             # can correlate its response with /agents/{id}/requests and the
             # engine's own logs (SURVEY §5.1 tracing requirement)
             out_headers[REQUEST_ID_HEADER] = request_id
+        if self._tier_enabled() and status == 200 and path.startswith("/chat"):
+            # turn settled: park after the linger window unless the session
+            # speaks again first (tool-call gaps cancel the pending park)
+            self._tier_schedule_park(agent_id, self._session_hint(body) or "default")
         return web.Response(
             status=status,
             body=resp_body,
@@ -1371,6 +1397,90 @@ class ControlPlaneApp:
             return str(doc.get("session", "") or "") if isinstance(doc, dict) else ""
         except (ValueError, UnicodeDecodeError):
             return ""
+
+    # -- tiered-KV proxy policy (park on settle, prewarm on arrival) ------
+
+    def _tier_enabled(self) -> bool:
+        feats = getattr(self.s.config, "features", None)
+        return bool(getattr(feats, "kv_tiering", False))
+
+    def _tier_on_arrival(self, agent_id: str, session: str) -> None:
+        """The conversation's next turn arrived: cancel any pending park
+        (the linger did its job) and, when the session is parked, send the
+        prewarm hint fire-and-forget so the engine's device swap-in runs
+        concurrently with this request's own dispatch + queue wait."""
+        key = (agent_id, session)
+        task = self._tier_linger_tasks.pop(key, None)
+        if task is not None:
+            task.cancel()
+        if key in self._tier_parked:
+            self._tier_parked.discard(key)
+            t = asyncio.ensure_future(self._tier_prewarm(agent_id, session))
+            self._tier_bg.add(t)
+            t.add_done_callback(self._tier_bg.discard)
+
+    def _tier_schedule_park(self, agent_id: str, session: str) -> None:
+        """Response complete: park the session after the linger window —
+        agentic traffic's tool-call gap — unless it speaks again first."""
+        key = (agent_id, session)
+        old = self._tier_linger_tasks.pop(key, None)
+        if old is not None:
+            old.cancel()
+        feats = getattr(self.s.config, "features", None)
+        linger = float(getattr(feats, "tier_park_linger_s", 1.0) or 0.0)
+        task = asyncio.ensure_future(self._tier_park_later(agent_id, session, linger))
+        self._tier_linger_tasks[key] = task
+
+        def _done(t, key=key):
+            if self._tier_linger_tasks.get(key) is t:
+                self._tier_linger_tasks.pop(key, None)
+
+        task.add_done_callback(_done)
+
+    async def _tier_park_later(self, agent_id: str, session: str, linger: float) -> None:
+        try:
+            if linger > 0:
+                await asyncio.sleep(linger)
+            status, _headers, rbody = await self.dispatch_to_agent(
+                agent_id,
+                "POST",
+                "/park",
+                {"Content-Type": "application/json"},
+                json.dumps({"session": session}).encode(),
+                session_hint=session,
+            )
+            parked = False
+            if status == 200:
+                try:
+                    parked = bool(json.loads(rbody).get("parked"))
+                except (ValueError, AttributeError, UnicodeDecodeError):
+                    parked = False
+            if parked:
+                self._tier_parked.add((agent_id, session))
+                self.tier_parks_total += 1
+            else:
+                self.tier_park_failures_total += 1
+        except asyncio.CancelledError:
+            raise  # the session spoke again; parking would be wrong now
+        except Exception:
+            # best-effort policy: a failed park only costs density, never
+            # correctness — counted for the metrics surface
+            self.tier_park_failures_total += 1
+
+    async def _tier_prewarm(self, agent_id: str, session: str) -> None:
+        try:
+            await self.dispatch_to_agent(
+                agent_id,
+                "POST",
+                "/prewarm",
+                {"Content-Type": "application/json"},
+                json.dumps({"session": session}).encode(),
+                session_hint=session,
+            )
+            self.tier_prewarms_total += 1
+        except Exception:
+            # best-effort hint: the engine still promotes at admission
+            self.tier_park_failures_total += 1
 
     async def _dispatch_once(
         self,

@@ -18,8 +18,12 @@ Policy, in order:
   its store-durable KV snapshot (SNAP_VERSION 3) + journaled fed stream,
   so decode resumes token-identically (the chaos soak asserts this);
 - **power-of-two-choices** — fresh sessions sample two candidates with a
-  seeded RNG and take the one with fewer in-flight dispatches: near-best
-  load spread at O(1) cost, no global queue view needed.
+  seeded RNG and take the less occupied one: near-best load spread at
+  O(1) cost. Occupancy is the ENGINE-reported queue+waiting+active depth
+  (fed by the replica monitor's probe loop) when a sample exists, else
+  the proxy-side in-flight count — the engine's own view also counts
+  replayed journal work, other proxies, and lanes still decoding after
+  their HTTP response settled, which the proxy count cannot see.
 
 Failpoints model STALE ROUTING STATE, the fleet's characteristic failure:
 ``router.pick`` firing returns a dead/excluded replica when one exists
@@ -85,6 +89,13 @@ class ReplicaRouter:
         )
         self._affinity_cap = 8192
         self._inflight: dict[str, int] = {}
+        # engine-REPORTED occupancy (queue depth + waiting + active lanes),
+        # fed by the replica monitor from each probe's metrics sample. When
+        # present it supersedes the proxy-side in-flight count for p2c: the
+        # proxy only sees its own dispatches, while the engine's own queue
+        # view also counts work from journal replays, other proxies, and
+        # lanes still decoding after the HTTP response settled.
+        self._load: dict[str, int] = {}
         self._health: dict[str, str] = {}
         self.picks_total = 0
         self.handoffs_total = 0
@@ -98,6 +109,22 @@ class ReplicaRouter:
 
     def health_of(self, engine_id: str) -> str:
         return self._health.get(engine_id, REPLICA_ALIVE)
+
+    def set_load(self, engine_id: str, depth: int) -> None:
+        """Record engine-reported occupancy for p2c (see ``_load``).
+        Negative clamps to zero so a junk sample can't make a replica
+        look infinitely attractive."""
+        with self._lock:
+            self._load[engine_id] = max(0, int(depth))
+
+    def _occupancy(self, engine_id: str) -> int:
+        """p2c load signal: engine-reported when the monitor has fed a
+        sample, else the proxy-side in-flight count (single-node deploys
+        and the window before the first probe)."""
+        load = self._load.get(engine_id)
+        if load is not None:
+            return load
+        return self._inflight.get(engine_id, 0)
 
     def on_replica_dead(self, agent_id: str, engine_id: str) -> None:
         """Fleet repair observed a replica death: exclude it and drop every
@@ -120,6 +147,7 @@ class ReplicaRouter:
         with self._lock:
             self._health.pop(engine_id, None)
             self._inflight.pop(engine_id, None)
+            self._load.pop(engine_id, None)
             for k in [k for k, eid in self._affinity.items() if eid == engine_id]:
                 del self._affinity[k]
 
@@ -210,8 +238,8 @@ class ReplicaRouter:
                 choice = usable[0]
             else:
                 a, b = self._rng.sample(usable, 2)
-                ia = self._inflight.get(a[0], 0)
-                ib = self._inflight.get(b[0], 0)
+                ia = self._occupancy(a[0])
+                ib = self._occupancy(b[0])
                 choice = a if ia <= ib else b
             if session:
                 self._affinity[key] = choice[0]
@@ -226,6 +254,7 @@ class ReplicaRouter:
         breakers = self.breakers.stats()
         with self._lock:
             inflight = dict(self._inflight)
+            load = dict(self._load)
             health = dict(self._health)
             affinity_count: dict[str, int] = {}
             for (_aid, _sess), eid in self._affinity.items():
@@ -244,6 +273,7 @@ class ReplicaRouter:
             replicas[eid] = {
                 "health": health.get(eid, REPLICA_ALIVE),
                 "inflight": inflight.get(eid, 0),
+                "load": load.get(eid),
                 "sessions": affinity_count.get(eid, 0),
                 "breaker": breakers.get(eid)
                 or {"state": "closed", "consecutive_failures": 0},

@@ -407,6 +407,75 @@ class Soak:
             return False
         return True
 
+    async def phase_park_kill(self, tiered_id: str) -> bool:
+        """SIGKILL with a session PARKED in the tiered-KV hierarchy: the
+        victim is demoted off-device (host tier + cold store blob) before
+        the kill, so the respawned engine has never held its pages — the
+        next turn must resume token-identically from the cold tier alone.
+        Pins that parking loses nothing a snapshot wouldn't: the cold
+        blob is packed from the exact staged arrays BEFORE any int8
+        host-tier quantization."""
+
+        async def turn(session: str, message: str) -> tuple[int, str]:
+            resp = await self.client.post(
+                f"/agent/{tiered_id}/chat",
+                data=json.dumps(
+                    {"message": message, "session": session, "max_tokens": 12}
+                ),
+            )
+            doc = await resp.json()
+            return resp.status, doc.get("response", "")
+
+        status, _ = await turn("pctl", "gamma gamma gamma")
+        assert status == 200, f"tiered ctl turn1 got {status}"
+        status, ctl_t2 = await turn("pctl", "delta delta")
+        assert status == 200, f"tiered ctl turn2 got {status}"
+        status, _ = await turn("pvic", "gamma gamma gamma")
+        assert status == 200, f"tiered vic turn1 got {status}"
+        # explicit park (the proxy's linger policy would get here on its
+        # own clock; the soak forces the timing): device pages free, host
+        # tier holds the session, and the serve layer writes the exact
+        # cold blob durably to the store
+        resp = await self.client.post(
+            f"/agent/{tiered_id}/park", data=json.dumps({"session": "pvic"})
+        )
+        doc = await resp.json()
+        if resp.status != 200 or not doc.get("parked"):
+            self.violations.append(
+                f"park_kill: park failed ({resp.status}: {doc})"
+            )
+            return False
+        kv_key = f"agent:{tiered_id}:kvcache:pvic"
+        if self.services.store.get(kv_key) is None:
+            self.violations.append("park_kill: cold-tier blob missing after park")
+            return False
+        engine_id = self.services.manager.get_agent(tiered_id).engine_id
+        self.services.backend.kill_engine_hard(engine_id)
+        t0 = time.monotonic()
+        recovered = False
+        while time.monotonic() - t0 < RECOVERY_CAP_S:
+            status, _ = await turn("probe-park", "ping")
+            if status == 200:
+                recovered = True
+                break
+            await asyncio.sleep(0.5)
+        self.mttr["park_kill"] = (
+            round(time.monotonic() - t0, 3) if recovered else -1.0
+        )
+        if not recovered:
+            self.violations.append("park_kill: engine never served again")
+            return False
+        status, vic_t2 = await turn("pvic", "delta delta")
+        if status != 200:
+            self.violations.append(f"park_kill: vic turn2 got {status}")
+            return False
+        if vic_t2 != ctl_t2:
+            self.violations.append(
+                f"park_kill token parity violated: {vic_t2!r} != {ctl_t2!r}"
+            )
+            return False
+        return True
+
     async def phase_fused_resume(self, fused_id: str) -> bool:
         """SIGKILL mid-FUSED-loop: the same token-identical contract as
         phase_llm_resume, but on a ``fused_decode=true`` engine whose armed
@@ -1229,6 +1298,28 @@ async def run_soak(tmpdir: str) -> dict:
             },
             env={"ATPU_FAULTS": "engine.page_alloc:error=RuntimeError,count=1"},
         )
+        tiered_id = await soak.deploy(
+            "chaos-tiered",
+            {
+                "engine": "llm",
+                "config": "tiny",
+                # paged arena + tiered-KV hierarchy: sessions park off the
+                # device into pinned host RAM (int8) and a cold store
+                # blob. park_kill SIGKILLs the engine while a session is
+                # parked and asserts its journaled turn resumes
+                # token-identically from the cold tier alone.
+                "options": {
+                    "max_batch": 2,
+                    "max_seq": 256,
+                    "prefill_chunk": 64,
+                    "paged_kv": True,
+                    "page_size": 32,
+                    "kv_pages": 32,
+                    "kv_tiering": True,
+                    "kv_snapshot_interval_s": 0.5,
+                },
+            },
+        )
 
         await soak.phase_baseline(echo_id, n_base)
         await soak.phase_engine_sigkill(echo_id)
@@ -1237,6 +1328,7 @@ async def run_soak(tmpdir: str) -> dict:
         await soak.phase_poisoned_prefill(poison_id)
         backpressured = await soak.phase_page_exhaustion(paged_id)
         token_identical = await soak.phase_llm_resume(llm_id)
+        park_identical = await soak.phase_park_kill(tiered_id)
         fused_identical = await soak.phase_fused_resume(fused_id)
         inject_identical = await soak.phase_fused_inject_resume(fused_inject_id)
         lease_ok = await soak.phase_lease_flap(fleet_echo_id)
@@ -1249,6 +1341,7 @@ async def run_soak(tmpdir: str) -> dict:
                 poison_id,
                 paged_id,
                 llm_id,
+                tiered_id,
                 fused_id,
                 fused_inject_id,
                 fleet_echo_id,
@@ -1256,6 +1349,7 @@ async def run_soak(tmpdir: str) -> dict:
             ]
         )
         inv["token_identical_resume"] = token_identical
+        inv["park_kill_token_identical"] = park_identical
         inv["fused_resume_token_identical"] = fused_identical
         inv["fused_inject_resume_token_identical"] = inject_identical
         inv["page_exhaustion_backpressure"] = backpressured

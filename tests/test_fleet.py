@@ -171,6 +171,58 @@ def test_router_power_of_two_prefers_less_loaded(tmp_path):
     assert picks == {b}
 
 
+def test_router_p2c_uses_engine_reported_load(tmp_path):
+    """Engine-reported occupancy supersedes the proxy-side in-flight
+    count: a replica the proxy believes idle but whose engine reports a
+    deep queue (journal replays, other proxies, lanes still decoding
+    after their response settled) loses the p2c coin."""
+    services, agent, router = _mk_router(tmp_path, n=2)
+    a, b = agent.all_engine_ids()
+    for _ in range(8):
+        router.begin(a)  # proxy-side picture: a is drowning...
+    # ...but the engines report the opposite: a is empty, b is deep
+    router.set_load(a, 0)
+    router.set_load(b, 12)
+    picks = {router.pick(agent).engine_id for _ in range(10)}
+    assert picks == {a}
+    stats = router.stats(agent)
+    assert stats["replicas"][a]["load"] == 0
+    assert stats["replicas"][b]["load"] == 12
+
+
+def test_router_load_falls_back_to_inflight_and_forgets(tmp_path):
+    """Before the monitor's first sample p2c falls back to the proxy-side
+    in-flight count; forget() drops the reported load with the rest of
+    the replica's state; junk samples clamp to zero."""
+    services, agent, router = _mk_router(tmp_path, n=2)
+    a, _b = agent.all_engine_ids()
+    assert router._occupancy(a) == 0
+    router.begin(a)
+    assert router._occupancy(a) == 1  # fallback: proxy-side count
+    router.set_load(a, 7)
+    assert router._occupancy(a) == 7  # engine sample supersedes
+    router.set_load(a, -3)
+    assert router._occupancy(a) == 0  # junk clamps, never attracts
+    router.forget(a)
+    assert router.stats(agent)["replicas"][a]["load"] is None
+
+
+def test_monitor_feeds_engine_load_to_router(tmp_path):
+    """The replica monitor's probe pass pushes each alive replica's
+    engine-reported queue+waiting+active depth into the router."""
+    services, agent, router, repair, mon = _mk_monitor(tmp_path)
+    a, b = agent.all_engine_ids()
+    depths = {
+        a: {"queue_depth": 2, "waiting_depth": 1, "active_requests": 3},
+        b: {"queue_depth": 0},
+    }
+    services.backend.stats = lambda eid: depths.get(eid)
+    mon.tick()
+    replicas = router.stats(agent)["replicas"]
+    assert replicas[a]["load"] == 6
+    assert replicas[b]["load"] == 0
+
+
 def test_router_excludes_suspect_and_dead(tmp_path):
     services, agent, router = _mk_router(tmp_path, n=3)
     a, b, c = agent.all_engine_ids()

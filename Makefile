@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all native test t1 test-native test-kernels bench overload spec decodeloop paged tiering fleet chaos server dryrun verify clean analyze analyze-native
+.PHONY: all native test t1 test-native test-kernels bench overload spec decodeloop paged tiering fleet streaming chaos server dryrun verify clean analyze analyze-native
 
 all: native
 
@@ -90,6 +90,13 @@ tiering:
 # ATPU_FLEET_SMOKE
 fleet:
 	JAX_PLATFORMS=cpu ATPU_FLEET_SMOKE=1 $(PY) scripts/bench_fleet.py
+
+# SSE streaming A/B (tiny model): streamed first-event latency vs the
+# buffered full-response wall under an admission burst, plus the
+# stream=false flag-parity guard (emission plumbing with no subscriber
+# must cost nothing); writes BENCH_streaming.json
+streaming:
+	JAX_PLATFORMS=cpu $(PY) scripts/bench_streaming.py
 
 # chaos soak: live daemon + engine subprocesses through the seeded fault
 # schedule (store blips, SIGKILLs, slow dispatch, torn AOF, poisoned

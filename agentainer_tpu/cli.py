@@ -159,6 +159,12 @@ def cmd_deploy(args) -> None:
         # sessions park to pinned host RAM/store and promote on return),
         # --no-kv-tiering pins the resident-only arena as the A/B baseline
         option_overrides["kv_tiering"] = bool(getattr(args, "kv_tiering", False))
+    if getattr(args, "streaming", False) or getattr(args, "no_streaming", False):
+        # SSE token streaming per deployment: --streaming opts the engine
+        # serve layer into stream=true handling (journaled offsets, crash-
+        # gapless failover splice), --no-streaming pins the buffered A/B
+        # baseline even when the fleet default (features.streaming) is on
+        option_overrides["streaming"] = bool(getattr(args, "streaming", False))
     if option_overrides:
         if isinstance(model, str):
             engine, _, config = model.partition(":")
@@ -561,6 +567,22 @@ def build_parser() -> argparse.ArgumentParser:
         help="pin this agent's engine to the resident-only KV arena "
         "(the A/B baseline) even when the fleet default "
         "features.kv_tiering is on",
+    )
+    streaming_group = s.add_mutually_exclusive_group()
+    streaming_group.add_argument(
+        "--streaming",
+        action="store_true",
+        help="enable SSE token streaming for this agent's engine "
+        "(stream=true chat bodies answer text/event-stream with every "
+        "token offset journaled; a mid-stream crash fails over with a "
+        "gapless splice; same as options.streaming: true in a "
+        "deployment YAML)",
+    )
+    streaming_group.add_argument(
+        "--no-streaming",
+        action="store_true",
+        help="pin this agent's engine to buffered responses (the A/B "
+        "baseline) even when the fleet default features.streaming is on",
     )
     s.add_argument("--health-endpoint", default="")
     s.add_argument("--health-interval", type=float, default=30.0)

@@ -83,6 +83,12 @@ class FeatureFlags:
     # Sized to agentic tool-call gaps — a tool round-trip inside the
     # linger cancels the park; anything longer pays one prewarm instead.
     tier_park_linger_s: float = 1.0
+    # Default for SSE token streaming (stream=true on /chat): the proxy
+    # forwards the engine's event stream with every offset journaled as a
+    # streaming checkpoint, so a mid-stream crash fails over gaplessly.
+    # Off by default — the buffered response path is the A/B baseline and
+    # stays byte-identical while this is off.
+    streaming: bool = False
 
 
 @dataclass
@@ -434,6 +440,15 @@ def load_config(path: str | None = None) -> Config:
     )
     if "ATPU_KV_TIERING" in env:
         cfg.features.kv_tiering = env["ATPU_KV_TIERING"].lower() in (
+            "1",
+            "true",
+            "yes",
+        )
+    cfg.features.streaming = bool(
+        feats.get("streaming", cfg.features.streaming)
+    )
+    if "ATPU_STREAMING" in env:
+        cfg.features.streaming = env["ATPU_STREAMING"].lower() in (
             "1",
             "true",
             "yes",

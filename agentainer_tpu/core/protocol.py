@@ -20,6 +20,22 @@ DRAINING_HEADER = "X-Agentainer-Draining"
 # the engine dropped the request by deadline/cancel policy — dead-letter,
 # never archive the notice as the request's completed response
 EXPIRED_HEADER = "X-Agentainer-Expired"
+# the request itself broke prefill on a HEALTHY engine (deterministic
+# input fault, not a crash): the proxy charges poison accounting instead
+# of archiving the 500 — two strikes dead-letters it (journal.mark_failed
+# poison=True)
+PREFILL_POISON_HEADER = "X-Agentainer-Prefill-Poisoned"
+
+# SSE streaming (stream=true on /chat, features.streaming)
+STREAM_CONTENT_TYPE = "text/event-stream"
+# standard SSE reconnect header; doubles as the proxy→engine splice
+# cursor on mid-stream failover: the engine serve layer re-emits the
+# deterministic sequence and skips every offset <= this value
+LAST_EVENT_ID_HEADER = "Last-Event-ID"
+# SSE event names on the wire
+STREAM_EVENT_TOKEN = "token"
+STREAM_EVENT_DONE = "done"
+STREAM_EVENT_ERROR = "error"
 
 # dispatch_to_agent sentinel outcomes (never valid HTTP statuses)
 DISPATCH_ENGINE_GONE = -1  # connection refused / engine vanished → stays pending

@@ -89,6 +89,7 @@ def build_services(
     os.environ["ATPU_INLOOP_SPEC"] = "1" if config.features.inloop_spec else "0"
     os.environ["ATPU_APPROX_TOPK"] = "1" if config.features.approx_topk else "0"
     os.environ["ATPU_KV_TIERING"] = "1" if config.features.kv_tiering else "0"
+    os.environ["ATPU_STREAMING"] = "1" if config.features.streaming else "0"
     os.environ["ATPU_DEADLINES"] = "1" if config.deadlines.enabled else "0"
     # Fault plane: the registry and the ATPU_FAULTS env the engines inherit
     # always reflect THIS config's schedule — same write-back-the-resolved-

@@ -173,6 +173,24 @@ class EngineDraining(RuntimeError):
     being finished and sessions snapshotted before exit."""
 
 
+class PrefillFailed(RuntimeError):
+    """Prefill broke for ONE request while the engine survived (the worker
+    loop's per-request isolation). For a fixed prompt this is essentially
+    deterministic — a poisoned input, not a transient — so the serve layer
+    marks the 500 with PREFILL_POISON_HEADER and the proxy charges poison
+    accounting (two strikes dead-letters the journal entry) instead of
+    riding the full respawn/backoff ladder."""
+
+
+def _as_prefill_failure(e: Exception) -> Exception:
+    """Classify a prefill-tick exception: policy terminations pass through
+    typed (they map to their own HTTP statuses); anything else becomes
+    PrefillFailed."""
+    if isinstance(e, (RequestAborted, EngineOverloaded, EngineShutdown)):
+        return e
+    return PrefillFailed(f"{type(e).__name__}: {e}")
+
+
 def _sharded_random_init(cfg: ModelConfig, dtype, mesh, specs: dict) -> dict:
     """Random-init DIRECTLY into shards: ``jit(init, out_shardings=...)``
     makes every chip allocate only its own slice of every weight, so a
@@ -220,6 +238,24 @@ class GenRequest:
     # steps, including in-flight chunks): the remaining budget bounds how
     # large a decode chunk is worth dispatching
     dispatched: int = 0
+    # SSE streaming: called from the worker thread as `emit(start, ids)`
+    # right after tokens land in `generated` (start = offset of ids[0]).
+    # Batches arrive FIFO and contiguous — the single worker thread is the
+    # only appender. None (the default, and every buffered request) keeps
+    # the readback paths byte-identical to pre-streaming behavior.
+    emit: Any = None
+
+    def emit_appended(self, n_new: int) -> None:
+        """Report the last ``n_new`` tokens of ``generated`` to the emit
+        callback (no-op without one). Never raises into the worker loop: a
+        dead stream consumer must not fail the generation — the buffered
+        result is still the journal's archive."""
+        if self.emit is None or n_new <= 0:
+            return
+        try:
+            self.emit(len(self.generated) - n_new, self.generated[-n_new:])
+        except Exception:
+            pass
 
 
 @dataclass
@@ -432,6 +468,7 @@ class LLMEngine:
         approx_topk: bool = False,
         kv_tiering: bool = False,
         tier_quantize: int = 1,
+        streaming: bool = False,
     ):
         self.cfg = cfg
         self.tokenizer = tokenizer
@@ -475,6 +512,11 @@ class LLMEngine:
         # is the default). Static per engine: it picks which sample_step
         # pipeline every compiled decode path bakes in.
         self.approx_topk = bool(approx_topk)
+        # SSE token streaming (opt-in): gates whether the serve layer
+        # honors stream=true on /chat. The engine side is just the
+        # per-request emit callback — sampling/batching are untouched, so
+        # streaming=False keeps buffered behavior byte-identical.
+        self.streaming = bool(streaming)
         self.page_size = max(8, int(page_size or PAGE_SIZE_DEFAULT))
         if self.paged:
             # the logical arena must tile exactly into pages
@@ -1133,6 +1175,7 @@ class LLMEngine:
                 approx_topk=bool(options.get("approx_topk", False)),
                 kv_tiering=bool(options.get("kv_tiering", False)),
                 tier_quantize=int(options.get("tier_quantize", 1) or 0),
+                streaming=bool(options.get("streaming", False)),
             )
             if not options.get("skip_warmup"):
                 engine.warmup()
@@ -1266,6 +1309,7 @@ class LLMEngine:
             approx_topk=bool(options.get("approx_topk", False)),
             kv_tiering=bool(options.get("kv_tiering", False)),
             tier_quantize=int(options.get("tier_quantize", 1) or 0),
+            streaming=bool(options.get("streaming", False)),
         )
         # pay the decode/prefill compiles here (inside the loader thread, while
         # /health keeps answering) instead of on the first user request.
@@ -1909,6 +1953,7 @@ class LLMEngine:
         ignore_eos: bool = False,
         top_k: int = 0,
         top_p: float = 1.0,
+        emit=None,
     ) -> dict:
         if request_id:
             with self._lock:
@@ -1939,6 +1984,7 @@ class LLMEngine:
             ignore_eos=ignore_eos,
             top_k=max(0, int(top_k)),
             top_p=min(1.0, max(0.0, float(top_p))) if top_p is not None else 1.0,
+            emit=emit,
         )
         self._queue.put(req)
         result = await req.future
@@ -1957,6 +2003,7 @@ class LLMEngine:
         request_id: str = "",
         deadline_at: float | None = None,
         ignore_eos: bool = False,
+        emit=None,
     ) -> dict:
         return await self.generate(
             prompt=message,
@@ -1966,6 +2013,7 @@ class LLMEngine:
             session=session or "default",
             deadline_at=deadline_at,
             ignore_eos=ignore_eos,
+            emit=emit,
         )
 
     def cancel(self, request_id: str) -> bool:
@@ -3474,7 +3522,7 @@ class LLMEngine:
                 self._note_error(e)
                 slot = self._prefilling_slot
                 if slot is not None and slot.request is not None:
-                    self._fail_item(slot.request, e)
+                    self._fail_item(slot.request, _as_prefill_failure(e))
                     self._reset_slot(slot)
                 self._ensure_device_state()
             finally:
@@ -5069,6 +5117,7 @@ class LLMEngine:
                     hit_eos = True
                     break
             req.generated.extend(int(t) for t in outs[:used])
+            req.emit_appended(used)
             req.dispatched += c
             self.tokens_generated += used
             total_used += used
@@ -5177,7 +5226,7 @@ class LLMEngine:
                     self._note_error(e)
                     slot = self._prefilling_slot
                     if slot is not None and slot.request is not None:
-                        self._fail_item(slot.request, e)
+                        self._fail_item(slot.request, _as_prefill_failure(e))
                         self._reset_slot(slot)
                     self._ensure_device_state()
                 finally:
@@ -5213,6 +5262,7 @@ class LLMEngine:
             )
             self.first_readback_ms_recent.append(1000 * (now - req.prefill_done_at))
         req.generated.append(first_id)
+        req.emit_appended(1)
         self.tokens_generated += 1
         if len(req.generated) >= req.max_tokens or (
             not req.ignore_eos and first_id == self.tokenizer.eos_id
@@ -5250,6 +5300,7 @@ class LLMEngine:
                     hit_eos = True
                     break
             req.generated.extend(int(t) for t in outs[:used])
+            req.emit_appended(used)
             self.tokens_generated += used
             # useful decode FLOPs only: overshoot tokens and parked lanes
             # are real compute but wasted — MFU should show that, not hide it
@@ -5330,6 +5381,7 @@ class LLMEngine:
                     hit_eos = True
                     break
             req.generated.extend(int(t) for t in outs[:used])
+            req.emit_appended(used)
             self.tokens_generated += used
             self.flops_done += used * self.cfg.flops_per_token(start + used // 2)
             finished = hit_eos or len(req.generated) >= req.max_tokens
@@ -5386,8 +5438,12 @@ def _reject(future: asyncio.Future, error: Exception) -> None:
     if not future.done():
         # EngineOverloaded covers worker-side PagePoolExhausted: pool
         # backpressure must reach the serve layer typed (429), not be
-        # laundered into a generic 500
-        if isinstance(error, (EngineShutdown, RequestAborted, EngineOverloaded)):
+        # laundered into a generic 500. PrefillFailed must survive for the
+        # same reason: the serve layer marks its 500 poisoned so the proxy
+        # charges the tightened dead-letter budget instead of archiving it
+        if isinstance(
+            error, (EngineShutdown, RequestAborted, EngineOverloaded, PrefillFailed)
+        ):
             future.set_exception(error)  # callers can catch the type
         else:
             future.set_exception(RuntimeError(f"engine worker error: {error}"))

@@ -36,8 +36,24 @@ from ..core.protocol import (  # noqa: E402, F401  (re-export)
     DEADLINE_HEADER,
     DRAINING_HEADER,
     EXPIRED_HEADER,
+    LAST_EVENT_ID_HEADER,
     LOADING_HEADER,
+    PREFILL_POISON_HEADER,
+    STREAM_CONTENT_TYPE,
+    STREAM_EVENT_DONE,
+    STREAM_EVENT_TOKEN,
 )
+from .. import faults  # noqa: E402
+
+
+def _sse_frame(event: str, event_id: int | None, data: dict) -> bytes:
+    """One SSE frame: optional ``id:`` (token offset — doubles as the
+    client's Last-Event-ID resume cursor), ``event:``, one-line data."""
+    lines = [f"event: {event}"]
+    if event_id is not None:
+        lines.append(f"id: {event_id}")
+    lines.append(f"data: {json.dumps(data, separators=(',', ':'))}")
+    return ("\n".join(lines) + "\n\n").encode()
 
 
 class LLMServeApp:
@@ -147,6 +163,21 @@ class LLMServeApp:
         self.draining = False
         self.drained_clean: bool | None = None
         self.drain_snapshots = 0
+        # SSE streaming surface (stream=true on /chat, engine streaming
+        # option): keep-alive cadence is configurable per deployment; the
+        # env channel covers fleet-wide defaults like the flag quad
+        try:
+            self.stream_heartbeat_s = float(
+                self.model_options.get(
+                    "stream_heartbeat_s", E.get("ATPU_STREAM_HEARTBEAT_S", 15.0)
+                )
+            )
+        except (TypeError, ValueError):
+            self.stream_heartbeat_s = 15.0
+        self.streams_started = 0
+        self.stream_tokens_emitted = 0
+        self.stream_heartbeats = 0
+        self.stream_client_disconnects = 0
 
     # engine + load state delegate to the host when this app is a tenant:
     # one LLMEngine (one weight copy) serves every attached agent
@@ -300,6 +331,7 @@ class LLMServeApp:
             ("inloop_spec", "ATPU_INLOOP_SPEC"),
             ("approx_topk", "ATPU_APPROX_TOPK"),
             ("kv_tiering", "ATPU_KV_TIERING"),
+            ("streaming", "ATPU_STREAMING"),
         ):
             raw = os.environ.get(env_name)
             if raw is not None and flag not in opts:
@@ -473,6 +505,17 @@ class LLMServeApp:
                     f"{traceback.format_exc()}",
                     flush=True,
                 )
+                # a typed prefill failure is the request's own fault on a
+                # healthy engine: mark the 500 so the proxy charges poison
+                # accounting instead of archiving or blaming the engine
+                headers = {}
+                try:
+                    from .llm import PrefillFailed
+
+                    if isinstance(e, PrefillFailed):
+                        headers[PREFILL_POISON_HEADER] = "true"
+                except ImportError:
+                    pass
                 return web.json_response(
                     {
                         "error": self.last_unhandled_error,
@@ -480,6 +523,7 @@ class LLMServeApp:
                         "agent_id": self.agent_id,
                     },
                     status=500,
+                    headers=headers,
                 )
 
         app = web.Application(middlewares=[json_errors])
@@ -507,6 +551,13 @@ class LLMServeApp:
             # plane still gets a ready callback (at attach, the host may
             # already be loaded; otherwise the host loader fans out).
             if self._host is not None:
+                return
+            if self.engine is not None:
+                # an engine was injected before startup (embedding, tests):
+                # loading again would orphan a second worker thread and
+                # race the injected engine out of self.engine
+                self._ready.set()
+                self._fan_out_ready()
                 return
             # DAEMON thread, not asyncio.to_thread: executor threads are
             # joined at interpreter exit, so a load blocked in the TPU
@@ -749,11 +800,29 @@ class LLMServeApp:
         # model's early EOS); kwarg-only-when-set, same as deadline_at
         if body.get("ignore_eos"):
             dl_kw["ignore_eos"] = True
+        # SSE streaming is opt-in per request AND flag-gated per engine
+        # (options.streaming / the ATPU_STREAMING quad): with the flag off,
+        # stream=true degrades to today's buffered response — the default
+        # path stays byte-identical as the A/B baseline
+        stream = bool(body.get("stream")) and bool(
+            getattr(self.engine, "streaming", False)
+        )
 
         if self.flatten_history:
             # gemini-agent-style turn: persona + last-N exchanges flattened
             # into ONE prompt string, generated statelessly (no KV session)
             prompt = await self._flattened_prompt(session, message)
+            if stream:
+                return await self._chat_streamed(
+                    request,
+                    session=session,
+                    message=message,
+                    prompt=prompt,
+                    max_tokens=max_tokens,
+                    request_id=request_id,
+                    dl_kw=dl_kw,
+                    flatten=True,
+                )
             try:
                 result = await self.engine.generate(
                     prompt=prompt,
@@ -804,6 +873,17 @@ class LLMServeApp:
         if self.system_prompt and not self._engine_has_session(session):
             prompt = f"{self.system_prompt}\n\n{message}"
 
+        if stream:
+            return await self._chat_streamed(
+                request,
+                session=session,
+                message=message,
+                prompt=prompt,
+                max_tokens=max_tokens,
+                request_id=request_id,
+                dl_kw=dl_kw,
+                flatten=False,
+            )
         try:
             result = await self.engine.chat(
                 session=self._sess(session),
@@ -835,6 +915,212 @@ class LLMServeApp:
                 "ttft_breakdown": result.get("ttft_breakdown"),
             }
         )
+
+    async def _chat_streamed(
+        self,
+        request: web.Request,
+        *,
+        session: str,
+        message: str,
+        prompt: str,
+        max_tokens: int,
+        request_id: str,
+        dl_kw: dict,
+        flatten: bool,
+    ) -> web.StreamResponse:
+        """SSE token stream for one /chat turn (stream=true).
+
+        Every ``token`` event carries a monotone offset (the ``id:`` line)
+        into the request's deterministic token sequence; ``done`` closes
+        with the exact payload the buffered path would have returned. The
+        offsets are the crash contract: a resume of the SAME journaled
+        request re-emits the sequence from offset 0 and this layer skips
+        everything at or below the Last-Event-ID splice cursor — so the
+        proxy's mid-stream failover (or a reconnecting client) observes one
+        gapless, duplicate-free sequence. Comment-frame keep-alives bridge
+        long prefills and never advance offsets. A memoized replay returns
+        the full result with no live emits; the catch-up loop re-emits it
+        under the same offsets, which is exactly what the splice needs.
+        """
+        self.streams_started += 1
+        # engine-side cancel needs an id; direct (proxy-less) clients may
+        # not send one
+        rid = request_id or f"stream-{time.monotonic_ns()}"
+        try:
+            last_acked = int(request.headers.get(LAST_EVENT_ID_HEADER, ""))
+        except (TypeError, ValueError):
+            last_acked = -1
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+
+        def emit(start: int, ids: list) -> None:  # worker thread → loop
+            loop.call_soon_threadsafe(q.put_nowait, (start, list(ids)))
+
+        if flatten:
+            gen = self.engine.generate(
+                prompt=prompt,
+                max_tokens=max_tokens,
+                request_id=rid,
+                emit=emit,
+                **dl_kw,
+            )
+        else:
+            gen = self.engine.chat(
+                session=self._sess(session),
+                message=prompt,
+                max_tokens=max_tokens,
+                request_id=rid,
+                emit=emit,
+                **dl_kw,
+            )
+        task = asyncio.ensure_future(gen)
+
+        def _on_done(t: asyncio.Task) -> None:
+            if not t.cancelled():
+                t.exception()  # mark retrieved; the loop re-reads via result()
+            q.put_nowait(("__done__", t))
+
+        task.add_done_callback(_on_done)
+
+        resp: web.StreamResponse | None = None
+        tokens: list[int] = []  # engine emission sequence seen so far
+        text = ""  # decoded prefix; per-event payload carries the delta
+        result = None
+
+        async def ensure_prepared() -> web.StreamResponse:
+            nonlocal resp
+            if resp is None:
+                resp = web.StreamResponse(
+                    status=200,
+                    headers={
+                        "Content-Type": STREAM_CONTENT_TYPE,
+                        "Cache-Control": "no-cache",
+                        "X-Accel-Buffering": "no",
+                    },
+                )
+                await resp.prepare(request)
+            return resp
+
+        async def send_tokens(start: int, ids: list) -> None:
+            nonlocal text
+            for i, tid in enumerate(ids):
+                off = start + i
+                if off < len(tokens):
+                    continue  # already seen (defensive; the worker is FIFO)
+                tokens.append(int(tid))
+                new_text = self.engine.tokenizer.decode(tokens)
+                delta, text_new = new_text[len(text):], new_text
+                text = text_new
+                if off <= last_acked:
+                    continue  # splice: the consumer already holds this one
+                # failpoint: the per-event emission seam — an armed error
+                # truncates the stream (no done frame), which is exactly
+                # the upstream failure the proxy's failover splice absorbs
+                await faults.fire_async("engine.stream")
+                r = await ensure_prepared()
+                await r.write(
+                    _sse_frame(
+                        STREAM_EVENT_TOKEN,
+                        off,
+                        {"offset": off, "token": int(tid), "text": delta},
+                    )
+                )
+                self.stream_tokens_emitted += 1
+
+        try:
+            while True:
+                try:
+                    item = await asyncio.wait_for(
+                        q.get(), timeout=max(0.05, self.stream_heartbeat_s)
+                    )
+                except asyncio.TimeoutError:
+                    # keep-alive comment frame: holds idle LB/client
+                    # timeouts open through long prefills and tool-call
+                    # gaps; carries no id, never advances the cursor
+                    r = await ensure_prepared()
+                    await r.write(b": keep-alive\n\n")
+                    self.stream_heartbeats += 1
+                    continue
+                if isinstance(item, tuple) and item[0] == "__done__":
+                    t = item[1]
+                    try:
+                        result = t.result()
+                    except Exception as e:
+                        if resp is None:
+                            # nothing sent yet: map to the same statuses as
+                            # the buffered path (429/503/504/499, poison
+                            # 500s via the middleware) so proxy
+                            # classification is unchanged
+                            pr = self._policy_response(e)
+                            if pr is None:
+                                raise
+                            return pr
+                        # mid-stream failure after bytes went out: close
+                        # WITHOUT a done frame — the truncation is the
+                        # upstream-failure signal the proxy fails over on
+                        return resp
+                    break
+                await send_tokens(*item)
+            # drain emits that landed between the final chunk and done
+            while not q.empty():
+                item = q.get_nowait()
+                if not (isinstance(item, tuple) and item[0] == "__done__"):
+                    await send_tokens(*item)
+            # memoized replay (and any lost tail): catch up from the
+            # result's token list under the same deterministic offsets
+            await send_tokens(len(tokens), list(result.get("tokens") or [])[len(tokens):])
+            if self.store.connected and not flatten:
+                stask = asyncio.ensure_future(self._snapshot_session(session))
+                self._bg_tasks.add(stask)
+                stask.add_done_callback(self._bg_tasks.discard)
+            await self._record_turn(session, message, result["text"])
+            payload = {
+                "response": result["text"],
+                "agent": self.agent_name,
+                "model": self.config_name,
+                "usage": {
+                    "prompt_tokens": result["prompt_tokens"],
+                    "completion_tokens": result["completion_tokens"],
+                },
+                "ttft_ms": result.get("ttft_ms"),
+                "ttft_breakdown": result.get("ttft_breakdown"),
+            }
+            if flatten:
+                payload["persona"] = self.system_prompt
+            r = await ensure_prepared()
+            await r.write(
+                _sse_frame(
+                    STREAM_EVENT_DONE,
+                    len(tokens) - 1 if tokens else None,
+                    payload,
+                )
+            )
+            await r.write_eof()
+            return r
+        except asyncio.CancelledError:
+            # aiohttp cancels the handler when the SSE consumer drops:
+            # propagate the abort into the engine so the lane frees
+            # mid-decode (PR 3's disconnect path, extended to streams)
+            self.stream_client_disconnects += 1
+            self.engine.cancel(rid)
+            raise
+        except ConnectionError:
+            self.stream_client_disconnects += 1
+            self.engine.cancel(rid)
+            if resp is not None:
+                return resp
+            return web.json_response(
+                {"error": "client disconnected"},
+                status=499,
+                reason="Client Closed Request",
+            )
+        except Exception:
+            if resp is None:
+                raise  # buffered-style mapping (middleware owns the 500)
+            # stream already under way: a clean error response is
+            # impossible — cancel the engine side and truncate
+            self.engine.cancel(rid)
+            return resp
 
     def _engine_has_session(self, session: str) -> bool:
         """Cross-tier membership: device-resident or parked in the host
@@ -1127,6 +1413,10 @@ class LLMServeApp:
             "drain_budget_s": self.drain_budget_s,
             "drained_clean": self.drained_clean,
             "drain_snapshots": self.drain_snapshots,
+            "streams_started": self.streams_started,
+            "stream_tokens_emitted": self.stream_tokens_emitted,
+            "stream_heartbeats": self.stream_heartbeats,
+            "stream_client_disconnects": self.stream_client_disconnects,
         }
         if self._host is not None or self._tenants:
             # HBM audit for the sharing demo: engine-level hbm byte counts

@@ -122,6 +122,13 @@ CATALOG: frozenset[str] = frozenset(
         "engine.prefill",
         "engine.decode_step",
         "engine.fused_decode",
+        # SSE streaming seams: the engine serve layer's per-event token
+        # write (firing = the upstream stream dies mid-emission → the
+        # proxy's failover splice takes over) and the proxy's per-event
+        # forward to the client (firing = a proxy-side dispatch failure
+        # mid-stream — the journal cursor keeps the splice exact)
+        "engine.stream",
+        "proxy.stream_emit",
         "engine.snapshot",
         "engine.page_alloc",
         # tiered KV hierarchy: a firing kv_demote leaves the session

@@ -27,6 +27,18 @@ from ..store.base import Store
 from ..store.schema import Keys, REQUEST_TTL_S
 
 MAX_RETRIES = 3  # requests.go:95
+# poisoned requests dead-letter faster than transient failures: the same
+# journaled request failing prefill deterministically TWICE on a healthy
+# engine is the input's fault, not the engine's — riding the full retry
+# ladder just re-burns prefill compute and stretches MTTR (ISSUE 20)
+POISON_RETRIES = 2
+
+
+class StreamGapError(RuntimeError):
+    """The stream cursor was asked to advance past a hole. A gap can only
+    mean tokens were emitted upstream but never acked through the journal
+    — silently skipping it would hand the client a token sequence with a
+    hole while claiming gaplessness, so this is a hard error."""
 
 
 class RequestStatus:
@@ -64,6 +76,11 @@ class JournaledRequest:
     # PROCESSING entries by this attribution instead of waiting out the
     # replay worker's staleness window.
     replica_id: str = ""
+    # streaming checkpoint: highest token offset acked to the client, -1 =
+    # nothing emitted (buffered requests never touch it). Advanced per
+    # event via advance_stream's CAS so replay-after-crash and a live
+    # failover can never double-emit the same offset.
+    stream_offset: int = -1
 
     def expired(self, now: float | None = None) -> bool:
         return self.deadline_at is not None and (now or time.time()) > self.deadline_at
@@ -89,6 +106,7 @@ class JournaledRequest:
             "updated_at": self.updated_at,
             "deadline_at": self.deadline_at,
             "replica_id": self.replica_id,
+            "stream_offset": self.stream_offset,
         }
 
     @staticmethod
@@ -111,6 +129,7 @@ class JournaledRequest:
                 float(d["deadline_at"]) if d.get("deadline_at") is not None else None
             ),
             replica_id=d.get("replica_id", ""),
+            stream_offset=int(d.get("stream_offset", -1)),
         )
 
 
@@ -254,6 +273,38 @@ class RequestJournal:
                 n += 1
         return n
 
+    def advance_stream(self, agent_id: str, request_id: str, offset: int) -> bool:
+        """Ack one streamed token offset against the entry's stream cursor.
+
+        CAS semantics mirror acquire_processing: of any two emitters racing
+        the same offset (live dispatch vs replay-after-crash, or two
+        failover legs overlapping), exactly one advance wins — the loser
+        gets False and must NOT forward the event. Contract:
+
+          offset == cursor + 1  → advance, True  (the only legal step)
+          offset <= cursor      → False          (duplicate; drop the event)
+          offset >  cursor + 1  → StreamGapError (hard error, never skipped)
+        """
+        key = Keys.request(agent_id, request_id)
+        for _ in range(4):
+            raw = self.store.get(key)
+            if raw is None:
+                return False
+            req = JournaledRequest.from_dict(json.loads(raw))
+            if offset <= req.stream_offset:
+                return False
+            if offset > req.stream_offset + 1:
+                raise StreamGapError(
+                    f"stream cursor gap for {agent_id}/{request_id}: "
+                    f"acked={req.stream_offset}, offered={offset}"
+                )
+            req.stream_offset = offset
+            req.updated_at = time.time()
+            new = json.dumps(req.to_dict(), separators=(",", ":"))
+            if self.store.cas(key, raw, new):
+                return True
+        return False
+
     def mark_processing(self, agent_id: str, request_id: str) -> None:
         """Best-effort processing flag for forced re-dispatch paths (manual
         replay of settled entries); racing dispatchers must use
@@ -271,15 +322,25 @@ class RequestJournal:
             req.status = RequestStatus.PENDING
             self._save(req)
 
-    def mark_failed(self, agent_id: str, request_id: str, error: str) -> None:
+    def mark_failed(
+        self, agent_id: str, request_id: str, error: str, poison: bool = False
+    ) -> None:
         """Retry accounting: under the cap the id stays pending for the next
-        replay pass; at the cap it is dead-lettered (requests.go:228-275)."""
+        replay pass; at the cap it is dead-lettered (requests.go:228-275).
+
+        ``poison=True`` is the deterministic-failure fast path (engine
+        reported the request itself breaks prefill): the cap drops to
+        POISON_RETRIES and the dead-letter reason is prefixed, so the same
+        input failing twice on a healthy engine is quarantined in ~one
+        replay tick instead of riding the respawn/backoff ladder. The
+        entry stays requeue-able (requeue resets the count)."""
         req = self.get(agent_id, request_id)
         if req is None:
             return
         req.retry_count += 1
-        req.error = error
-        if req.retry_count >= req.max_retries:
+        cap = min(POISON_RETRIES, req.max_retries) if poison else req.max_retries
+        req.error = f"poisoned prefill: {error}" if poison else error
+        if req.retry_count >= cap:
             req.status = RequestStatus.FAILED
             self._save(req)
             self.store.lrem(Keys.pending(agent_id), 1, request_id)

@@ -36,7 +36,7 @@ from .. import faults
 from ..core.errors import AgentainerError, AgentNotFound
 from ..core.resilience import CircuitBreaker, retry_after_jitter
 from ..core.spec import AgentStatus, HealthCheckConfig, ModelRef, Resources
-from ..manager.journal import RequestStatus
+from ..manager.journal import RequestStatus, StreamGapError
 from ..store.schema import Keys
 from .router import ReplicaChoice, ReplicaRouter
 
@@ -53,9 +53,15 @@ from ..core.protocol import (  # noqa: F401  (re-export)
     DISPATCH_IN_FLIGHT,
     DRAINING_HEADER,
     EXPIRED_HEADER,
+    LAST_EVENT_ID_HEADER,
     LOADING_HEADER,
+    PREFILL_POISON_HEADER,
     REPLAY_HEADER,
     REQUEST_ID_HEADER,
+    STREAM_CONTENT_TYPE,
+    STREAM_EVENT_DONE,
+    STREAM_EVENT_ERROR,
+    STREAM_EVENT_TOKEN,
 )
 
 _STORE_OPS = {
@@ -89,6 +95,34 @@ _HOP_BY_HOP = {
     # Content-Encoding would label a plain body as compressed
     "content-encoding",
 }
+
+
+class _StreamClientGone(Exception):
+    """The SSE consumer's transport died mid-write. Distinct type on
+    purpose: a ConnectionResetError from ``resp.write`` (client side) must
+    never be classified like an upstream reset (engine side) — one aborts
+    the request, the other fails over to a survivor."""
+
+
+def _parse_sse_frame(raw: bytes) -> tuple[str, int | None, bytes]:
+    """One ``\\n\\n``-delimited SSE block → (event, id, data). A pure
+    comment block (keep-alive heartbeat) parses as event ``""``."""
+    event, eid, data = "", None, b""
+    comment = True
+    for ln in raw.split(b"\n"):
+        if ln.startswith(b":") or not ln.strip():
+            continue
+        comment = False
+        if ln.startswith(b"event:"):
+            event = ln[6:].strip().decode("utf-8", "replace")
+        elif ln.startswith(b"id:"):
+            try:
+                eid = int(ln[3:].strip())
+            except (TypeError, ValueError):
+                eid = None
+        elif ln.startswith(b"data:"):
+            data = ln[5:].strip()
+    return ("" if comment else (event or "message")), eid, data
 
 
 def _tail_snapshot(path: str, tail: int) -> tuple[list[bytes], int]:
@@ -176,6 +210,15 @@ class ControlPlaneApp:
         self.journal_errors_total = 0
         self.journal_skipped_total = 0
         self.abort_cancel_errors_total = 0
+        # SSE streaming data path (features.streaming): per-event forwards,
+        # mid-stream failovers (upstream died → survivor re-spliced), CAS-
+        # suppressed duplicate emissions, and dropped consumers
+        self.stream_requests_total = 0
+        self.stream_events_total = 0
+        self.stream_failovers_total = 0
+        self.stream_dup_suppressed_total = 0
+        self.stream_client_disconnects_total = 0
+        self.stream_write_errors_total = 0
         # tiered-KV proxy policy (features.kv_tiering): the proxy SEES the
         # agent's conversation — it parks a session after its response
         # settles (plus a linger window for fast tool-call round-trips)
@@ -750,6 +793,12 @@ class ControlPlaneApp:
                 "tier_park_failures_total": self.tier_park_failures_total,
                 "tier_prewarms_total": self.tier_prewarms_total,
                 "tier_parked_sessions": len(self._tier_parked),
+                "stream_requests_total": self.stream_requests_total,
+                "stream_events_total": self.stream_events_total,
+                "stream_failovers_total": self.stream_failovers_total,
+                "stream_dup_suppressed_total": self.stream_dup_suppressed_total,
+                "stream_client_disconnects_total": self.stream_client_disconnects_total,
+                "stream_write_errors_total": self.stream_write_errors_total,
             }
         )
 
@@ -967,6 +1016,19 @@ class ControlPlaneApp:
         headers.pop(REPLAY_HEADER, None)
         headers.pop(REQUEST_ID_HEADER, None)
 
+        # SSE streaming opt-in (features.streaming AND {"stream": true} in
+        # the chat body). A client RECONNECT after a dropped stream carries
+        # Last-Event-ID (the highest offset it holds) plus the request id
+        # it was issued: that pair re-attaches to the SAME journal entry —
+        # no new journal write, no new generation (the engine memo-replays
+        # the deterministic sequence; the proxy skips offsets <= the
+        # floor). The echoed id is only ever used to splice a stream,
+        # never to settle an entry or skip journaling of fresh work.
+        stream = self._wants_stream(path, body)
+        resume_rid = ""
+        if stream and request.headers.get(LAST_EVENT_ID_HEADER, ""):
+            resume_rid = request.headers.get(REQUEST_ID_HEADER, "").strip()
+
         # Per-request deadline: an explicit header always sticks; the config
         # default applies ONLY when the agent is up to serve synchronously.
         # A request accepted with 202 "queued for replay" keeps the
@@ -1024,6 +1086,10 @@ class ControlPlaneApp:
             # whose entry was never durably written.
             if not self._store_breaker.allow():
                 self.journal_skipped_total += 1
+            elif resume_rid:
+                # stream resume: the entry is already journaled under the
+                # id the client echoed back — re-journaling would fork it
+                request_id = resume_rid
             else:
                 try:
                     journaled = self.s.journal.store_request(
@@ -1078,6 +1144,17 @@ class ControlPlaneApp:
             # so the engine's host→device swap-in overlaps this request's
             # own queue wait (the TTFT admission phase hides the restore)
             self._tier_on_arrival(agent_id, self._session_hint(body) or "default")
+
+        if stream:
+            return await self._proxy_stream(
+                request,
+                agent,
+                path,
+                headers,
+                body,
+                request_id=request_id,
+                deadline_at=deadline_at,
+            )
 
         dispatch = asyncio.ensure_future(
             self.dispatch_to_agent(
@@ -1398,6 +1475,464 @@ class ControlPlaneApp:
         except (ValueError, UnicodeDecodeError):
             return ""
 
+    # -- SSE streaming data path (features.streaming) ---------------------
+
+    def _wants_stream(self, path: str, body: bytes) -> bool:
+        """The streamed data path engages only when the feature flag is on
+        AND the chat body opted in — stream=false (the default) must keep
+        the buffered proxy byte-identical to the pre-streaming build."""
+        if not bool(getattr(self.s.config.features, "streaming", False)):
+            return False
+        if not path.startswith("/chat"):
+            return False
+        if not body:
+            return False
+        try:
+            doc = json.loads(body)
+            return bool(doc.get("stream")) if isinstance(doc, dict) else False
+        except (ValueError, UnicodeDecodeError):
+            return False
+
+    async def _proxy_stream(
+        self,
+        request: web.Request,
+        agent,
+        path: str,
+        headers: dict[str, str],
+        body: bytes,
+        request_id: str = "",
+        deadline_at: float | None = None,
+    ) -> web.StreamResponse:
+        """Streamed dispatch: forward the engine's SSE token stream to the
+        client, journaling every offset as a streaming checkpoint BEFORE it
+        goes on the wire (checkpoint-then-emit).
+
+        The failure contract is the whole point:
+
+        - **mid-stream upstream death** (replica SIGKILL, payload reset,
+          injected ``proxy.stream_emit`` fault): nothing client-visible is
+          lost — the cursor names the last acked offset, the next leg
+          carries it as ``Last-Event-ID``, the survivor restores the
+          session, memo/deterministically re-emits, and the serve layer
+          skips every offset <= cursor. The client sees ONE gapless,
+          duplicate-free sequence on ONE connection;
+        - **duplicate emission** (replay-after-crash racing a live leg):
+          ``journal.advance_stream`` CAS-rejects the second advance and the
+          local cursor drops the event before the write;
+        - **offset gap**: :class:`StreamGapError` — a hard error that
+          truncates the stream; a silent skip would corrupt the splice;
+        - **client disconnect**: the entry settles EXPIRED at the last
+          acked offset and the engine's lane is cancelled (the streamed
+          extension of the buffered abort path);
+        - **non-stream upstream outcomes** (loading/draining 503, poisoned
+          prefill 500, 429 shed) classify exactly like the buffered path.
+        """
+        agent_id = agent.id
+        self.stream_requests_total += 1
+        multi = len(agent.all_engine_ids()) > 1
+        session_hint = self._session_hint(body)
+        rid_headers = {REQUEST_ID_HEADER: request_id} if request_id else None
+        # the client's splice floor: highest offset it already holds (a
+        # reconnect sends its Last-Event-ID; a fresh stream starts at -1)
+        floor = -1
+        raw_floor = request.headers.get(LAST_EVENT_ID_HEADER, "")
+        if raw_floor:
+            try:
+                floor = int(raw_floor)
+            except (TypeError, ValueError):
+                floor = -1
+        resume = bool(raw_floor)
+
+        if multi:
+            choice = self.router.pick(agent, session=session_hint)
+        else:
+            endpoint = self.s.manager.endpoint(agent)
+            choice = (
+                None if endpoint is None else ReplicaChoice(agent.engine_id, endpoint)
+            )
+        if choice is None:
+            return fail(
+                "agent unreachable; request left pending for replay",
+                status=502,
+                headers=rid_headers,
+            )
+        if deadline_at is not None and time.time() > deadline_at:
+            if request_id:
+                self._journal_op(
+                    self.s.journal.mark_expired,
+                    agent_id,
+                    request_id,
+                    reason="deadline exceeded",
+                )
+            return fail(
+                "deadline exceeded; request dead-lettered",
+                status=504,
+                headers=rid_headers,
+            )
+        if request_id and not resume:
+            # same pending→processing CAS claim as the buffered path; a
+            # resume re-attaches to an entry that is already PROCESSING or
+            # COMPLETED (the engine memo replays it), so it skips the claim
+            try:
+                claimed = self.s.journal.acquire_processing(
+                    agent_id, request_id, replica_id=choice.engine_id
+                )
+            except Exception:
+                self._store_breaker.fail()
+                self.journal_errors_total += 1
+                claimed = False
+            if not claimed:
+                archived = await self._await_archived(agent_id, request_id, deadline_at)
+                if archived is not None:
+                    return archived
+                return fail("request already being dispatched", status=409)
+
+        import aiohttp
+        from aiohttp import ClientTimeout as _CT
+
+        state: dict = {"resp": None, "cursor": floor}
+        t0 = time.monotonic()
+
+        async def ensure_prepared() -> web.StreamResponse:
+            if state["resp"] is None:
+                r = web.StreamResponse(status=200)
+                r.headers["Content-Type"] = STREAM_CONTENT_TYPE
+                r.headers["Cache-Control"] = "no-cache"
+                r.headers["X-Accel-Buffering"] = "no"
+                if request_id:
+                    # the resume credential: a reconnect echoes this id +
+                    # its Last-Event-ID to re-splice the same entry
+                    r.headers[REQUEST_ID_HEADER] = request_id
+                await r.prepare(request)
+                state["resp"] = r
+            return state["resp"]
+
+        async def client_write(payload: bytes) -> None:
+            r = await ensure_prepared()
+            try:
+                await r.write(payload)
+            except (ConnectionResetError, ConnectionError) as e:
+                raise _StreamClientGone() from e
+
+        def settle_plain(
+            status: int, rheaders: dict[str, str], rbody: bytes
+        ) -> tuple[str, web.Response | None]:
+            """Engine answered but not with a stream: classify exactly like
+            the buffered path, then serve the plain outcome."""
+            if status == 503 and (
+                rheaders.get(LOADING_HEADER, "").lower() == "true"
+                or rheaders.get(DRAINING_HEADER, "").lower() == "true"
+            ):
+                return "retry", None
+            if rheaders.get(EXPIRED_HEADER, "").lower() == "true":
+                if request_id:
+                    self._journal_op(
+                        self.s.journal.mark_expired,
+                        agent_id,
+                        request_id,
+                        reason="expired on engine",
+                    )
+                return "plain", fail(
+                    "deadline exceeded; request dead-lettered",
+                    status=504,
+                    headers=rid_headers,
+                )
+            if status >= 500 and rheaders.get(PREFILL_POISON_HEADER, "").lower() == "true":
+                # deterministic input fault on a healthy engine: charge
+                # poison accounting instead of archiving the 500
+                if request_id:
+                    self._journal_op(
+                        self.s.journal.mark_failed,
+                        agent_id,
+                        request_id,
+                        f"prefill poisoned (HTTP {status})",
+                        poison=True,
+                    )
+            elif status == 429:
+                if request_id:
+                    self._journal_op(self.s.journal.mark_pending, agent_id, request_id)
+            elif request_id:
+                self._journal_op(
+                    self.s.journal.store_response,
+                    agent_id,
+                    request_id,
+                    status,
+                    rheaders,
+                    rbody,
+                )
+            out = {
+                k: v
+                for k, v in rheaders.items()
+                if k.lower() not in _HOP_BY_HOP and k.lower() != "content-type"
+            }
+            if request_id:
+                out[REQUEST_ID_HEADER] = request_id
+            return "plain", web.Response(
+                status=status,
+                body=rbody,
+                headers=out,
+                content_type=rheaders.get("Content-Type", "application/octet-stream").split(";")[0],
+            )
+
+        async def forward_frame(raw: bytes) -> web.StreamResponse | None:
+            """Forward one upstream SSE block; returns the finished
+            response on the terminal ``done`` event, else None."""
+            event, eid, data = _parse_sse_frame(raw)
+            if event == "":
+                # keep-alive comment frame: forwarded verbatim, NEVER
+                # advances the journaled offset
+                await client_write(raw + b"\n\n")
+                return None
+            if event == STREAM_EVENT_TOKEN:
+                off = eid if eid is not None else state["cursor"] + 1
+                if off <= state["cursor"]:
+                    # duplicate emission (overlapping failover legs / memo
+                    # re-emit racing the splice): dropped before the wire
+                    self.stream_dup_suppressed_total += 1
+                    return None
+                if off != state["cursor"] + 1:
+                    raise StreamGapError(
+                        f"stream splice gap for {agent_id}/{request_id or '<unjournaled>'}: "
+                        f"acked={state['cursor']}, offered={off}"
+                    )
+                # proxy-side per-event failpoint: firing here models a
+                # dispatch failure mid-stream — the cursor is NOT advanced,
+                # so the failover leg re-offers exactly this offset
+                await faults.fire_async("proxy.stream_emit")
+                if request_id:
+                    # checkpoint-then-emit: the journaled cursor is never
+                    # behind what a FUTURE leg must skip. False = the
+                    # offset was already journaled (a reconnect re-serving
+                    # acked events below the journal cursor): still owed to
+                    # THIS client, whose own floor admitted it.
+                    try:
+                        self.s.journal.advance_stream(agent_id, request_id, off)
+                    except StreamGapError:
+                        raise
+                    except Exception:
+                        # a store blip must not kill a live stream; the
+                        # replay-side CAS still guards double emission
+                        self._store_breaker.fail()
+                        self.journal_errors_total += 1
+                await client_write(raw + b"\n\n")
+                state["cursor"] = off
+                self.stream_events_total += 1
+                return None
+            if event == STREAM_EVENT_DONE:
+                # archive the done payload as the entry's completed
+                # response — byte-identical to what the buffered path
+                # would have archived, so /requests/{rid} and replay
+                # semantics don't fork on the streaming flag
+                if request_id:
+                    self._journal_op(
+                        self.s.journal.store_response,
+                        agent_id,
+                        request_id,
+                        200,
+                        {"Content-Type": "application/json"},
+                        bytes(data),
+                    )
+                await client_write(raw + b"\n\n")
+                r = state["resp"]
+                await r.write_eof()
+                return r
+            # unknown/error event: forward verbatim (forward-compat)
+            await client_write(raw + b"\n\n")
+            return None
+
+        async def one_leg() -> tuple[str, web.StreamResponse | web.Response | None]:
+            url = choice.endpoint.rstrip("/") + path
+            fwd = dict(headers)
+            fwd.pop("Authorization", None)
+            fwd.pop(DEADLINE_HEADER, None)
+            if request_id:
+                fwd[REQUEST_ID_HEADER] = request_id
+            if state["cursor"] >= 0:
+                # the splice cursor: the engine serve layer re-emits its
+                # deterministic sequence and skips offsets <= this value
+                fwd[LAST_EVENT_ID_HEADER] = str(state["cursor"])
+            else:
+                fwd.pop(LAST_EVENT_ID_HEADER, None)
+            if deadline_at is not None:
+                remaining = deadline_at - time.time()
+                fwd[DEADLINE_HEADER] = str(max(1, int(remaining * 1000)))
+            # no total timeout: a healthy stream outlives any fixed budget
+            # (engine heartbeats bound sock_read instead)
+            timeout = _CT(total=None, sock_connect=10.0, sock_read=90.0)
+            async with self._client.request(
+                request.method,
+                url,
+                headers=fwd,
+                data=body if body else None,
+                timeout=timeout,
+            ) as upstream:
+                ctype = upstream.headers.get("Content-Type", "")
+                if upstream.status != 200 or not ctype.startswith(STREAM_CONTENT_TYPE):
+                    rbody = await upstream.read()
+                    return settle_plain(upstream.status, dict(upstream.headers), rbody)
+                buf = b""
+                async for chunk in upstream.content.iter_any():
+                    buf += chunk
+                    while b"\n\n" in buf:
+                        raw, buf = buf.split(b"\n\n", 1)
+                        finished = await forward_frame(raw)
+                        if finished is not None:
+                            return "done", finished
+                # upstream closed without a done event: mid-stream death
+                return "retry", None
+
+        tried: set[str] = set()
+        attempts = 0
+        max_attempts = 1 + (self.router.retry_next_replica if multi else 2)
+        try:
+            while True:
+                attempts += 1
+                if multi:
+                    self.router.begin(choice.engine_id)
+                replica_ok = False
+                try:
+                    kind, terminal = await one_leg()
+                    replica_ok = True
+                except (
+                    aiohttp.ClientError,
+                    ConnectionError,
+                    asyncio.TimeoutError,
+                    OSError,
+                    faults.FaultInjected,
+                ):
+                    kind, terminal = "retry", None
+                finally:
+                    if multi:
+                        self.router.end(choice.engine_id, replica_ok)
+                if kind == "done":
+                    self.s.metrics.count_request(
+                        agent_id, latency_s=time.monotonic() - t0
+                    )
+                    if self._tier_enabled():
+                        self._tier_schedule_park(agent_id, session_hint or "default")
+                    return terminal
+                if kind == "plain":
+                    if state["resp"] is None:
+                        return terminal
+                    # already streaming and a failover leg settled plain:
+                    # nothing splice-able is coming — truncate with an
+                    # error frame (the journal settle already happened)
+                    await self._stream_error_frame(
+                        state, f"upstream settled non-stream (HTTP {terminal.status})"
+                    )
+                    return state["resp"]
+                # retryable: the leg died with the cursor intact — fail
+                # over and re-splice at last_acked_offset + 1
+                tried.add(choice.engine_id)
+                nxt = None
+                if attempts < max_attempts:
+                    if multi:
+                        nxt = self.router.pick(
+                            agent, session=session_hint, exclude=frozenset(tried)
+                        )
+                        if nxt is None:
+                            # every survivor already tried: re-open the full
+                            # set (a respawned replica may be back)
+                            nxt = self.router.pick(agent, session=session_hint)
+                    else:
+                        await asyncio.sleep(0.5)
+                        endpoint = self.s.manager.endpoint(agent)
+                        nxt = (
+                            None
+                            if endpoint is None
+                            else ReplicaChoice(agent.engine_id, endpoint)
+                        )
+                if nxt is None:
+                    break
+                choice = nxt
+                if state["resp"] is not None or state["cursor"] > floor:
+                    self.stream_failovers_total += 1
+                if request_id:
+                    self._journal_op(
+                        self.s.journal.set_replica, agent_id, request_id, choice.engine_id
+                    )
+        except asyncio.CancelledError:
+            # aiohttp cancels the handler when the consumer vanishes
+            self.stream_client_disconnects_total += 1
+            await self._abort_stream(agent_id, request_id, choice)
+            raise
+        except _StreamClientGone:
+            self.stream_client_disconnects_total += 1
+            await self._abort_stream(agent_id, request_id, choice)
+            if state["resp"] is not None:
+                return state["resp"]
+            return web.Response(status=499, reason="Client Closed Request")
+        except StreamGapError as e:
+            # hard invariant break — never silently skipped. The entry is
+            # left un-settled (PROCESSING): the replay reclaim re-serves it
+            # buffered, where the archived response is whole-or-nothing.
+            try:
+                self.s.logs.error("proxy", f"stream gap on {agent_id}: {e}")
+            except Exception:
+                pass
+            if state["resp"] is None:
+                raise
+            await self._stream_error_frame(state, str(e))
+            return state["resp"]
+
+        # every leg exhausted: the entry goes back to pending (replay will
+        # settle it buffered) and the client may reconnect with
+        # Last-Event-ID + the request id to re-splice what it is owed
+        if request_id:
+            self._journal_op(self.s.journal.mark_pending, agent_id, request_id)
+        if state["resp"] is not None:
+            await self._stream_error_frame(
+                state, "upstream lost mid-stream; reconnect with Last-Event-ID to resume"
+            )
+            return state["resp"]
+        return fail(
+            "agent unreachable; request left pending for replay",
+            status=502,
+            headers=rid_headers,
+        )
+
+    async def _stream_error_frame(self, state: dict, message: str) -> None:
+        """Best-effort terminal error frame + EOF on an already-started
+        stream (a truncated stream with no ``done`` IS the failure signal;
+        the frame just names the reason)."""
+        r = state.get("resp")
+        if r is None:
+            return
+        try:
+            payload = json.dumps({"error": message}, separators=(",", ":"))
+            await r.write(
+                f"event: {STREAM_EVENT_ERROR}\ndata: {payload}\n\n".encode()
+            )
+            await r.write_eof()
+        except Exception:
+            # the consumer is already gone; the frame just couldn't land
+            self.stream_write_errors_total += 1
+
+    async def _abort_stream(self, agent_id: str, request_id: str, choice) -> None:
+        """Streamed client disconnect: settle the entry EXPIRED at the last
+        acked offset (the stream cursor already journaled it) and cancel
+        the engine lane on the replica actually serving the stream."""
+        if request_id:
+            self._journal_op(
+                self.s.journal.mark_expired,
+                agent_id,
+                request_id,
+                reason="client disconnected mid-stream",
+            )
+        try:
+            if choice is not None and request_id:
+                await self._cancel_on_engine(choice.endpoint, request_id)
+        except Exception as e:
+            self.abort_cancel_errors_total += 1
+            try:
+                self.s.logs.warn(
+                    "proxy",
+                    f"engine cancel failed for {agent_id}/{request_id}: "
+                    f"{type(e).__name__}: {e}",
+                )
+            except Exception:
+                pass
+
     # -- tiered-KV proxy policy (park on settle, prewarm on arrival) ------
 
     def _tier_enabled(self) -> bool:
@@ -1641,6 +2176,26 @@ class ControlPlaneApp:
                     reason="expired on engine",
                 )
             return (DISPATCH_EXPIRED, {}, b""), True
+        if (
+            resp.status >= 500
+            and resp_headers.get(PREFILL_POISON_HEADER, "").lower() == "true"
+        ):
+            # the REQUEST itself breaks prefill on a healthy engine
+            # (deterministic input fault, not a crash): archiving the 500
+            # as COMPLETED would hide it; leaving it pending would replay
+            # it forever. Poison accounting dead-letters it after
+            # POISON_RETRIES strikes (~one replay tick), cutting the
+            # repair MTTR from the full respawn/backoff ladder to ~1 s,
+            # and the entry stays requeue-able for the operator.
+            if request_id:
+                self._journal_op(
+                    self.s.journal.mark_failed,
+                    agent_id,
+                    request_id,
+                    f"prefill poisoned (HTTP {resp.status})",
+                    poison=True,
+                )
+            return (resp.status, resp_headers, resp_body), True
         if resp.status == 429:
             # engine-side shed: overload is transient — the entry goes back
             # to pending for a later replay tick (no retry charged; losing
@@ -1734,7 +2289,9 @@ class ControlPlaneApp:
             ) as resp:
                 await resp.read()
         except Exception:
-            pass
+            # cancel is advisory (a dead engine makes it moot) but the lane
+            # keeps decoding for a vanished caller when this fails — count it
+            self.abort_cancel_errors_total += 1
 
 
 def create_app(services: "Services") -> web.Application:

@@ -1,0 +1,171 @@
+"""Streamed-vs-buffered serving benchmark: time-to-first-event under load.
+
+A/B for the SSE token-streaming path (ISSUE 20). The SAME tiny-engine
+config is driven through an admission burst (background lanes keep the
+decode loop busy while waves of probes arrive), measuring per probe:
+
+  first_event_ms   — submit → the FIRST emit-callback delivery: what an
+                     SSE consumer waits before tokens start flowing
+                     (engine first-token latency + emission plumbing)
+  full_ms          — submit → the complete buffered result: what the
+                     stream=false caller waits for the same request
+
+The headline is the p50 ratio full/first — how much sooner a streamed
+client sees output under contention. The guard is flag parity: with the
+``streaming`` engine option on but no emit callback attached (every
+stream=false request), the buffered wall must match a streaming=False
+engine within noise — the flag quad's A/B baseline is the flag, and the
+emission plumbing must cost nothing when nobody subscribes.
+
+Runs on whatever JAX platform is available: emission is host-side worker
+machinery riding the existing per-chunk/fused readbacks, so a CPU run is
+a faithful A/B even though absolute latencies are smaller than on a TPU.
+
+Usage: JAX_PLATFORMS=cpu python scripts/bench_streaming.py
+Emits one JSON line on stdout; the committed artifact is
+BENCH_streaming.json.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _benchlib import make_engine, percentile as _p, write_artifact
+
+MODEL = os.environ.get("ATPU_STREAM_MODEL", "tiny")
+WAVES = int(os.environ.get("ATPU_STREAM_WAVES", "4"))
+WAVE_K = int(os.environ.get("ATPU_STREAM_WAVE_K", "4"))
+PROBE_TOKENS = int(os.environ.get("ATPU_STREAM_PROBE_TOKENS", "192"))
+MAX_BATCH = int(os.environ.get("ATPU_STREAM_MAX_BATCH", "8"))
+PROBE_PROMPT = "stream the answer back token by token please " * 4
+BG_PROMPT = "keep the decode loop busy in the background "
+
+
+def _mk(streaming: bool):
+    return make_engine(
+        MODEL,
+        max_batch=MAX_BATCH,
+        max_seq=512,
+        decode_chunk=8,
+        prefill_chunk=64,
+        streaming=streaming,
+    )
+
+
+async def _burst(eng, with_emit: bool) -> dict:
+    """Waves of simultaneous probes against busy background lanes; returns
+    per-probe first-event and full-response walls."""
+    bg = [
+        asyncio.ensure_future(
+            eng.generate(BG_PROMPT * (i + 1), max_tokens=700, ignore_eos=True)
+        )
+        for i in range(2)
+    ]
+    await asyncio.sleep(0.3)  # background lanes are decoding
+    first_ms: list[float] = []
+    full_ms: list[float] = []
+    ttft_ms: list[float] = []
+    try:
+        for _ in range(WAVES):
+
+            async def probe():
+                t0 = time.monotonic()
+                marks: list[float] = []
+                emit = (lambda start, ids: marks.append(time.monotonic())) if with_emit else None
+                r = await eng.generate(
+                    PROBE_PROMPT,
+                    max_tokens=PROBE_TOKENS,
+                    ignore_eos=True,
+                    emit=emit,
+                )
+                t1 = time.monotonic()
+                if marks:
+                    first_ms.append(1000 * (marks[0] - t0))
+                    if r.get("ttft_ms") is not None:
+                        ttft_ms.append(float(r["ttft_ms"]))
+                full_ms.append(1000 * (t1 - t0))
+                return r
+
+            await asyncio.gather(*[probe() for _ in range(WAVE_K)])
+        return {
+            "first_ms": sorted(first_ms),
+            "full_ms": sorted(full_ms),
+            "ttft_ms": sorted(ttft_ms),
+        }
+    finally:
+        for t in bg:
+            t.cancel()
+        await asyncio.gather(*bg, return_exceptions=True)
+
+
+async def run() -> dict:
+    eng_on = _mk(streaming=True)
+    try:
+        streamed = await _burst(eng_on, with_emit=True)
+        buffered = await _burst(eng_on, with_emit=False)
+    finally:
+        eng_on.shutdown()
+    eng_off = _mk(streaming=False)
+    try:
+        baseline = await _burst(eng_off, with_emit=False)
+    finally:
+        eng_off.shutdown()
+
+    first_p50 = _p(streamed["first_ms"], 0.50)
+    full_p50 = _p(streamed["full_ms"], 0.50)
+    buf_p50 = _p(buffered["full_ms"], 0.50)
+    base_p50 = _p(baseline["full_ms"], 0.50)
+    return {
+        "metric": "stream_first_event_speedup",
+        # how much sooner a streamed consumer sees output than a buffered
+        # one waits for the full response, same engine, same contention
+        "value": round(full_p50 / max(first_p50, 1e-6), 2)
+        if first_p50 and full_p50
+        else None,
+        "unit": "x",
+        "model": MODEL,
+        "waves": WAVES,
+        "wave_k": WAVE_K,
+        "probe_tokens": PROBE_TOKENS,
+        "platform": os.environ.get("JAX_PLATFORMS", ""),
+        "streamed": {
+            "first_event_ms_p50": first_p50,
+            "first_event_ms_p90": _p(streamed["first_ms"], 0.90),
+            "full_ms_p50": full_p50,
+            # the tracking guard: the first emitted event must ride the
+            # engine's own first-token latency, not trail the full turn
+            "engine_ttft_ms_p50": _p(streamed["ttft_ms"], 0.50),
+        },
+        "buffered_streaming_engine": {
+            "full_ms_p50": buf_p50,
+            "full_ms_p90": _p(buffered["full_ms"], 0.90),
+        },
+        "buffered_baseline_engine": {
+            "full_ms_p50": base_p50,
+            "full_ms_p90": _p(baseline["full_ms"], 0.90),
+        },
+        # the stream=false guard: emission plumbing with no subscriber must
+        # not tax the buffered path (ratio ~1.0, noise-bounded on CPU)
+        "flag_parity_ratio": round(buf_p50 / max(base_p50, 1e-6), 3)
+        if buf_p50 and base_p50
+        else None,
+    }
+
+
+def main() -> int:
+    doc = asyncio.run(run())
+    doc["wall_s"] = round(time.monotonic() - T0, 1)
+    write_artifact("BENCH_streaming.json", doc)
+    return 0
+
+
+T0 = time.monotonic()
+
+if __name__ == "__main__":
+    sys.exit(main())

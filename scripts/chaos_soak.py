@@ -14,7 +14,10 @@ subprocesses — through deterministic fault phases:
                     serve-through degradation, loop survival)
   slow_dispatch     proxy.dispatch delay failpoint (latency, not loss)
   poisoned_prefill  engine.prefill failpoint inside a real LLM engine
-                    subprocess (per-request isolation: the engine survives)
+                    subprocess: the typed poison signal dead-letters the
+                    failing request after two fast strikes (reason
+                    recorded, requeue-able) while the engine survives and
+                    keeps serving the healthy traffic behind it
   llm_sigkill       SIGKILL the LLM host process, then token-identical
                     session resume from the KV snapshot
   fused_inject      SIGKILL a fused+in-loop-spec engine while a second
@@ -26,6 +29,11 @@ subprocesses — through deterministic fault phases:
                     SURVIVOR with a token-identical continuation (restored
                     from the store-durable snapshot), and the next live
                     turn matches the control session bit for bit
+  stream_kill       SIGKILL the replica serving a live SSE stream
+                    mid-decode: the client's single connection sees one
+                    gapless, duplicate-free offset sequence bit-for-bit
+                    equal to the undisturbed control — the proxy splices
+                    the survivor's stream at last_acked_offset + 1
   lease_flap        replica.lease failpoint starves heartbeat refreshes on
                     a healthy 2-replica echo fleet: replicas flap SUSPECT
                     (excluded from routing) and return ALIVE when the
@@ -118,6 +126,9 @@ class Soak:
         cfg.fleet.lease_interval_s = 0.25
         cfg.fleet.suspect_after_s = 1.0
         cfg.fleet.dead_after_s = 2.0
+        # SSE token streaming through the proxy: the stream_kill phase
+        # asserts the mid-stream failover splice end to end
+        cfg.features.streaming = True
         os.environ["ATPU_JITTER_SEED"] = str(SEED)
         backend = LocalBackend(
             data_dir=self.tmpdir,
@@ -273,21 +284,87 @@ class Soak:
         faults.disarm_all()
         self.mttr["slow_dispatch"] = round((time.monotonic() - t0) / max(1, n), 3)
 
-    async def phase_poisoned_prefill(self, poison_id: str) -> None:
-        """The poison agent's engine armed engine.prefill (count=2) from its
-        env: the first two prefills fail (isolated to their requests), the
-        engine SURVIVES and serves everything after."""
-        failures = 0
-        for _ in range(4):
-            status, _ = await self.chat(poison_id, track=False)
-            if status >= 500:
-                failures += 1
-            await asyncio.sleep(0.1)
-        if failures == 0:
+    async def phase_poisoned_prefill(self, poison_id: str) -> bool:
+        """One deterministically failing request (engine.prefill armed with
+        count=2) on a HEALTHY engine. Repair-path contract: the engine's
+        typed poison signal (PREFILL_POISON_HEADER on the 500) charges the
+        tightened poison budget instead of archiving the 500 or walking the
+        full retry ladder — the entry dead-letters in seconds with the
+        reason recorded, stays requeue-able, and the engine serves the
+        traffic behind it throughout. MTTR here is first-5xx → dead-letter:
+        the repair decision latency, not the model-load wall clock the old
+        probe conflated it with."""
+        agent = self.services.manager.get_agent(poison_id)
+        t_warm = time.monotonic()
+        while time.monotonic() - t_warm < RECOVERY_CAP_S:
+            stats = self.services.backend.stats(agent.engine_id) or {}
+            if stats.get("model_loaded"):
+                break
+            await asyncio.sleep(0.5)
+        else:
+            self.violations.append("poisoned_prefill: engine never loaded")
+            self.mttr["poisoned_prefill"] = -1.0
+            return False
+        resp = await self.client.post(
+            f"/agent/{poison_id}/chat",
+            data=json.dumps({"message": f"poison-{SEED}"}),
+        )
+        await resp.read()
+        t0 = time.monotonic()
+        rid = resp.headers.get("X-Agentainer-Request-ID", "")
+        if resp.status < 500 or not rid:
             self.violations.append(
-                "poisoned_prefill: failpoint never fired (seam not wired?)"
+                f"poisoned_prefill: failpoint never fired (got {resp.status})"
             )
-        await self.probe_until_ok(poison_id, "poisoned_prefill")
+            self.mttr["poisoned_prefill"] = -1.0
+            return False
+        # strike 1 was the live dispatch; the next replay tick is strike 2
+        req = None
+        while time.monotonic() - t0 < RECOVERY_CAP_S:
+            req = self.services.journal.get(poison_id, rid)
+            if req is not None and req.status == "failed":
+                break
+            await asyncio.sleep(0.05)
+        if req is None or req.status != "failed":
+            self.violations.append(
+                "poisoned_prefill: entry never dead-lettered "
+                f"({None if req is None else req.status})"
+            )
+            self.mttr["poisoned_prefill"] = -1.0
+            return False
+        self.mttr["poisoned_prefill"] = round(time.monotonic() - t0, 3)
+        ok = True
+        if "poisoned prefill" not in (req.error or ""):
+            self.violations.append(
+                f"poisoned_prefill: reason not recorded ({req.error!r})"
+            )
+            ok = False
+        # the dead letter is an operator artifact: requeue must revive it,
+        # and with the failpoint's count=2 consumed it now completes
+        if self.services.journal.requeue(poison_id, rid) is None:
+            self.violations.append("poisoned_prefill: dead letter not requeue-able")
+            ok = False
+        else:
+            t_rq = time.monotonic()
+            while time.monotonic() - t_rq < RECOVERY_CAP_S:
+                req = self.services.journal.get(poison_id, rid)
+                if req is not None and req.status == "completed":
+                    break
+                await asyncio.sleep(0.25)
+            if req is None or req.status != "completed":
+                self.violations.append(
+                    "poisoned_prefill: requeued entry never completed "
+                    f"({None if req is None else req.status})"
+                )
+                ok = False
+        # the engine was healthy the whole time: live traffic still serves
+        status, _ = await self.chat(poison_id, track=False)
+        if status != 200:
+            self.violations.append(
+                f"poisoned_prefill: healthy traffic got {status} after dead-letter"
+            )
+            ok = False
+        return ok
 
     async def phase_page_exhaustion(self, paged_id: str) -> bool:
         """Paged-KV backpressure invariant: the paged agent runs a tiny
@@ -962,6 +1039,172 @@ class Soak:
             return False
         return True
 
+    async def phase_stream_kill(self, fleet_id: str) -> bool:
+        """SIGKILL the replica SERVING a live SSE stream mid-decode. The
+        tentpole invariant: the client's single connection sees one
+        gapless, duplicate-free offset sequence 0..n-1 whose token stream
+        is bit-for-bit the undisturbed control's — the proxy fails over to
+        the survivor and splices at exactly last_acked_offset + 1, no
+        client reconnect involved. The journaled entry settles COMPLETED
+        with its stream cursor at the final offset."""
+
+        def parse_frames(raw: bytes):
+            frames = []
+            for block in raw.split(b"\n\n"):
+                if not block.strip() or block.lstrip().startswith(b":"):
+                    continue  # keep-alive comments carry no offset
+                event, eid, data = "", None, None
+                for ln in block.split(b"\n"):
+                    if ln.startswith(b"event:"):
+                        event = ln[6:].strip().decode()
+                    elif ln.startswith(b"id:"):
+                        eid = int(ln[3:].strip())
+                    elif ln.startswith(b"data:"):
+                        data = json.loads(ln[5:].strip())
+                frames.append((event, eid, data))
+            return frames
+
+        async def turn(session: str, message: str, n: int = 12, stream: bool = False):
+            # control and victim MUST send byte-identical prompts (the
+            # token comparison is bit-for-bit), so no self.chat sequencing
+            resp = await self.client.post(
+                f"/agent/{fleet_id}/chat",
+                data=json.dumps(
+                    {
+                        "message": message,
+                        "session": session,
+                        "stream": stream,
+                        "max_tokens": n,
+                        "ignore_eos": True,
+                    }
+                ),
+            )
+            return resp
+
+        # both replicas past model load (an earlier phase may have killed
+        # and respawned one of them)
+        agent = self.services.manager.get_agent(fleet_id)
+        t_warm = time.monotonic()
+        for eid in agent.all_engine_ids():
+            while time.monotonic() - t_warm < 90.0:
+                stats = self.services.backend.stats(eid) or {}
+                if stats.get("model_loaded"):
+                    break
+                await asyncio.sleep(0.5)
+            else:
+                self.violations.append(f"stream_kill: replica {eid} never loaded")
+                return False
+
+        # undisturbed control: same two turns the victim will run
+        resp = await turn("sctl", "epsilon epsilon epsilon")
+        await resp.read()
+        if resp.status != 200:
+            self.violations.append(f"stream_kill: ctl turn1 got {resp.status}")
+            return False
+        resp = await turn("sctl", "delta delta", n=24, stream=True)
+        if resp.status != 200 or not resp.headers.get("Content-Type", "").startswith(
+            "text/event-stream"
+        ):
+            self.violations.append(
+                f"stream_kill: ctl stream got {resp.status} "
+                f"({resp.headers.get('Content-Type', '')!r})"
+            )
+            return False
+        ctl_frames = parse_frames(await resp.read())
+        ctl_tokens = [f[2]["token"] for f in ctl_frames if f[0] == "token"]
+        ctl_done = [f[2] for f in ctl_frames if f[0] == "done"]
+        if not ctl_tokens or len(ctl_done) != 1:
+            self.violations.append("stream_kill: control stream malformed")
+            return False
+
+        # victim session: turn1 pins affinity and lands a durable snapshot
+        # (the failover resume restores from it, same as replica_failover)
+        resp = await turn("svic", "epsilon epsilon epsilon")
+        await resp.read()
+        if resp.status != 200:
+            self.violations.append(f"stream_kill: vic turn1 got {resp.status}")
+            return False
+        kv_key = f"agent:{fleet_id}:kvcache:svic"
+        t_snap = time.monotonic()
+        while self.services.store.get(kv_key) is None:
+            if time.monotonic() - t_snap > 45.0:
+                self.violations.append("stream_kill: KV snapshot never landed")
+                return False
+            await asyncio.sleep(0.25)
+        victim_replica = self._affine_replica(fleet_id, "svic")
+        if not victim_replica:
+            self.violations.append("stream_kill: no session affinity recorded")
+            return False
+
+        # open the victim stream, read a few live events, then SIGKILL the
+        # serving replica with the rest of the decode still in flight
+        resp = await turn("svic", "delta delta", n=24, stream=True)
+        if resp.status != 200:
+            self.violations.append(f"stream_kill: vic stream got {resp.status}")
+            return False
+        rid = resp.headers.get("X-Agentainer-Request-ID", "")
+        raw = b""
+        seen_tokens = 0
+        try:
+            while seen_tokens < 3:
+                raw += await asyncio.wait_for(
+                    resp.content.readuntil(b"\n\n"), timeout=RECOVERY_CAP_S
+                )
+                seen_tokens = sum(1 for f in parse_frames(raw) if f[0] == "token")
+        except (asyncio.TimeoutError, asyncio.IncompleteReadError):
+            self.violations.append("stream_kill: stream stalled before the kill")
+            return False
+        t_kill = time.monotonic()
+        self.services.backend.kill_engine_hard(victim_replica)
+        try:
+            raw += await asyncio.wait_for(resp.content.read(), timeout=RECOVERY_CAP_S)
+        except asyncio.TimeoutError:
+            self.violations.append("stream_kill: stream never finished after kill")
+            self.mttr["stream_kill"] = -1.0
+            return False
+        frames = parse_frames(raw)
+        tokens = [f for f in frames if f[0] == "token"]
+        dones = [f[2] for f in frames if f[0] == "done"]
+        errors = [f for f in frames if f[0] == "error"]
+        ok = True
+        # THE invariant: gapless, duplicate-free, bit-for-bit the control
+        offsets = [f[1] for f in tokens]
+        if offsets != list(range(len(offsets))):
+            self.violations.append(f"stream_kill: offsets not gapless: {offsets}")
+            ok = False
+        if [f[2]["token"] for f in tokens] != ctl_tokens:
+            self.violations.append("stream_kill: spliced token stream diverged")
+            ok = False
+        if len(dones) != 1 or errors:
+            self.violations.append(
+                f"stream_kill: terminal frames wrong (done={len(dones)}, "
+                f"error={len(errors)})"
+            )
+            ok = False
+        elif dones[0].get("response") != ctl_done[0].get("response"):
+            self.violations.append("stream_kill: done payload diverged from control")
+            ok = False
+        self.mttr["stream_kill"] = round(time.monotonic() - t_kill, 3) if ok else -1.0
+        # journal: archived COMPLETED with the cursor at the final offset
+        if rid:
+            req = self.services.journal.get(fleet_id, rid)
+            if req is None or req.status != "completed":
+                self.violations.append(
+                    "stream_kill: streamed entry not archived "
+                    f"({None if req is None else req.status})"
+                )
+                ok = False
+            elif req.stream_offset != len(ctl_tokens) - 1:
+                self.violations.append(
+                    f"stream_kill: cursor {req.stream_offset} != "
+                    f"{len(ctl_tokens) - 1}"
+                )
+                ok = False
+        else:
+            self.violations.append("stream_kill: no request id on stream")
+            ok = False
+        return ok
+
     async def phase_lease_flap(self, fleet_echo_id: str) -> bool:
         """Heartbeat starvation without a death: the replica.lease
         failpoint fails refreshes until its budget is spent, so healthy
@@ -1211,6 +1454,9 @@ async def run_soak(tmpdir: str) -> dict:
                     "prefill_chunk": 64,
                     "kv_snapshot_interval_s": 0.5,
                     "speculative": False,
+                    # incremental emission on: stream_kill SIGKILLs the
+                    # replica serving a live SSE stream mid-decode
+                    "streaming": True,
                 },
             },
             replicas=2,
@@ -1325,7 +1571,7 @@ async def run_soak(tmpdir: str) -> dict:
         await soak.phase_engine_sigkill(echo_id)
         await soak.phase_store_blip(echo_id, n_blip)
         await soak.phase_slow_dispatch(echo_id, n_slow)
-        await soak.phase_poisoned_prefill(poison_id)
+        poison_ok = await soak.phase_poisoned_prefill(poison_id)
         backpressured = await soak.phase_page_exhaustion(paged_id)
         token_identical = await soak.phase_llm_resume(llm_id)
         park_identical = await soak.phase_park_kill(tiered_id)
@@ -1334,6 +1580,7 @@ async def run_soak(tmpdir: str) -> dict:
         lease_ok = await soak.phase_lease_flap(fleet_echo_id)
         route_ok = await soak.phase_route_dead(fleet_echo_id)
         failover_ok = await soak.phase_replica_failover(fleet_llm_id)
+        stream_ok = await soak.phase_stream_kill(fleet_llm_id)
 
         inv = await soak.settle(
             [
@@ -1356,6 +1603,8 @@ async def run_soak(tmpdir: str) -> dict:
         inv["lease_flap_recovers"] = lease_ok
         inv["route_dead_absorbed"] = route_ok
         inv["replica_failover_token_identical"] = failover_ok
+        inv["stream_kill_gapless"] = stream_ok
+        inv["poisoned_dead_letter"] = poison_ok
     finally:
         await soak.stop()
     aof = torn_aof_check(tmpdir)

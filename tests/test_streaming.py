@@ -1,0 +1,803 @@
+"""Crash-gapless SSE token streaming (ISSUE 20).
+
+Journal level: the per-entry stream cursor only advances by exactly one
+(duplicates CAS-rejected, gaps a hard error), buffered entries never touch
+it, and poisoned-prefill accounting dead-letters after two strikes while
+staying requeue-able. Engine level: the emit callback reports a contiguous
+offset sequence that equals the final token list on both the per-chunk and
+fused readback paths. Serve level: stream=true answers text/event-stream
+with monotone offsets, a done payload matching the buffered response, a
+Last-Event-ID splice over the memoized replay, keep-alive heartbeats, and
+client-disconnect → engine cancel. Proxy level: mid-stream upstream death
+fails over with an exact splice (one gapless, duplicate-free client
+sequence), duplicate emissions are suppressed, offset gaps truncate hard,
+streamed disconnects settle the entry EXPIRED + cancel the engine lane,
+and a poisoned-prefill 500 classifies as poison instead of archiving.
+"""
+
+import asyncio
+import json
+import time
+from types import SimpleNamespace
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from agentainer_tpu.config import Config
+from agentainer_tpu.core.protocol import (
+    LAST_EVENT_ID_HEADER,
+    PREFILL_POISON_HEADER,
+    REQUEST_ID_HEADER,
+    STREAM_CONTENT_TYPE,
+)
+from agentainer_tpu.daemon import build_services
+from agentainer_tpu.engine.llm import LLMEngine
+from agentainer_tpu.engine.llm_serve import LLMServeApp
+from agentainer_tpu.manager.journal import (
+    RequestJournal,
+    RequestStatus,
+    StreamGapError,
+)
+from agentainer_tpu.runtime.backend import FakeBackend
+from agentainer_tpu.store import MemoryStore
+
+TOKEN = "stream-token"
+AUTH = {"Authorization": f"Bearer {TOKEN}"}
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_journal():
+    store = MemoryStore()
+    return store, RequestJournal(store)
+
+
+def make_engine(**opts) -> LLMEngine:
+    o = dict(max_batch=1, max_seq=256, decode_chunk=4, prefill_chunk=32)
+    o.update(opts)
+    return LLMEngine.create("tiny", options=o)
+
+
+def parse_sse(raw: bytes):
+    """bytes → list of (event, id, data_dict | None); comments parse as
+    ("", None, None)."""
+    out = []
+    for block in raw.split(b"\n\n"):
+        if not block.strip():
+            continue
+        event, eid, data = "", None, None
+        comment = True
+        for ln in block.split(b"\n"):
+            if ln.startswith(b":"):
+                continue
+            comment = False
+            if ln.startswith(b"event:"):
+                event = ln[6:].strip().decode()
+            elif ln.startswith(b"id:"):
+                eid = int(ln[3:].strip())
+            elif ln.startswith(b"data:"):
+                data = json.loads(ln[5:].strip())
+        out.append(("" if comment else event, eid, data))
+    return out
+
+
+# -- journal: the stream cursor contract ----------------------------------
+def test_stream_cursor_advances_by_exactly_one():
+    _, j = make_journal()
+    req = j.store_request("a", "POST", "/chat", {}, b"{}")
+    assert j.get("a", req.id).stream_offset == -1  # nothing emitted yet
+    for off in range(3):
+        assert j.advance_stream("a", req.id, off) is True
+    assert j.get("a", req.id).stream_offset == 2
+    # replay splice resumes at exactly cursor + 1
+    assert j.advance_stream("a", req.id, 3) is True
+
+
+def test_stream_cursor_rejects_duplicates():
+    _, j = make_journal()
+    req = j.store_request("a", "POST", "/chat", {}, b"{}")
+    assert j.advance_stream("a", req.id, 0) is True
+    # replay-after-crash racing a live failover offers the same offset:
+    # exactly one advance wins; the loser must not forward the event
+    assert j.advance_stream("a", req.id, 0) is False
+    assert j.advance_stream("a", req.id, -5) is False
+    assert j.get("a", req.id).stream_offset == 0
+
+
+def test_stream_cursor_gap_is_hard_error():
+    _, j = make_journal()
+    req = j.store_request("a", "POST", "/chat", {}, b"{}")
+    assert j.advance_stream("a", req.id, 0) is True
+    with pytest.raises(StreamGapError):
+        j.advance_stream("a", req.id, 2)
+    # the failed advance must not have moved the cursor
+    assert j.get("a", req.id).stream_offset == 0
+
+
+def test_stream_cursor_cas_contention_single_winner():
+    import threading
+
+    _, j = make_journal()
+    req = j.store_request("a", "POST", "/chat", {}, b"{}")
+    barrier = threading.Barrier(2)
+    wins = []
+
+    def racer():
+        barrier.wait()
+        wins.append(j.advance_stream("a", req.id, 0))
+
+    threads = [threading.Thread(target=racer) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(wins) == [False, True]
+
+
+def test_buffered_journal_semantics_unchanged():
+    """stream=false entries never touch the cursor: store_request →
+    store_response round-trips exactly as before with stream_offset -1."""
+    _, j = make_journal()
+    req = j.store_request("a", "POST", "/chat", {}, b'{"message":"hi"}')
+    j.store_response("a", req.id, 200, {"Content-Type": "application/json"}, b"{}")
+    settled = j.get("a", req.id)
+    assert settled.status == RequestStatus.COMPLETED
+    assert settled.stream_offset == -1
+    assert settled.response["status_code"] == 200
+
+
+def test_poisoned_prefill_dead_letters_after_two_strikes():
+    _, j = make_journal()
+    req = j.store_request("a", "POST", "/chat", {}, b"{}")
+    j.mark_failed("a", req.id, "prefill exploded", poison=True)
+    first = j.get("a", req.id)
+    assert first.status == RequestStatus.PENDING  # one strike: replay-able
+    assert first.retry_count == 1
+    j.mark_failed("a", req.id, "prefill exploded", poison=True)
+    dead = j.get("a", req.id)
+    assert dead.status == RequestStatus.FAILED
+    assert dead.error.startswith("poisoned prefill:")
+    assert [r.id for r in j.by_status("a", "failed")] == [req.id]
+    # the dead letter stays requeue-able (operator recovery path)
+    requeued = j.requeue("a", req.id)
+    assert requeued is not None and requeued.retry_count == 0
+    assert j.get("a", req.id).status == RequestStatus.PENDING
+
+
+def test_non_poison_failures_keep_full_retry_budget():
+    _, j = make_journal()
+    req = j.store_request("a", "POST", "/chat", {}, b"{}")
+    j.mark_failed("a", req.id, "transient")
+    j.mark_failed("a", req.id, "transient")
+    assert j.get("a", req.id).status == RequestStatus.PENDING  # 2 < MAX_RETRIES
+    j.mark_failed("a", req.id, "transient")
+    assert j.get("a", req.id).status == RequestStatus.FAILED
+
+
+# -- engine: emit callback contiguity -------------------------------------
+def test_engine_emit_offsets_contiguous_per_chunk():
+    eng = make_engine()
+    try:
+        emitted = []
+        res = run(
+            eng.generate(
+                "count with me",
+                max_tokens=8,
+                ignore_eos=True,
+                emit=lambda start, ids: emitted.append((start, list(ids))),
+            )
+        )
+        seq = []
+        for start, ids in emitted:
+            assert start == len(seq)  # contiguous from offset 0, in order
+            seq.extend(int(t) for t in ids)
+        assert seq == [int(t) for t in res["tokens"]]
+        assert len(seq) == 8
+    finally:
+        eng.shutdown()
+
+
+def test_engine_emit_offsets_contiguous_fused():
+    eng = make_engine(fused_decode=True)
+    try:
+        emitted = []
+        res = run(
+            eng.generate(
+                "count with me",
+                max_tokens=8,
+                ignore_eos=True,
+                emit=lambda start, ids: emitted.append((start, list(ids))),
+            )
+        )
+        seq = []
+        for start, ids in emitted:
+            assert start == len(seq)
+            seq.extend(int(t) for t in ids)
+        assert seq == [int(t) for t in res["tokens"]]
+    finally:
+        eng.shutdown()
+
+
+# -- serve layer: SSE over real HTTP --------------------------------------
+def _serve_app(engine) -> LLMServeApp:
+    app = LLMServeApp(env={"AGENTAINER_AGENT_ID": "stream"})
+    app.engine = engine
+    return app
+
+
+def test_serve_stream_offsets_and_done_payload():
+    async def body():
+        eng = make_engine(streaming=True)
+        serve = _serve_app(eng)
+        client = TestClient(TestServer(serve.app()))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/chat",
+                json={
+                    "message": "hello there",
+                    "session": "s",
+                    "stream": True,
+                    "max_tokens": 6,
+                    "ignore_eos": True,
+                },
+                headers={REQUEST_ID_HEADER: "r1"},
+            )
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith(STREAM_CONTENT_TYPE)
+            events = parse_sse(await resp.content.read())
+            toks = [e for e in events if e[0] == "token"]
+            dones = [e for e in events if e[0] == "done"]
+            assert [e[1] for e in toks] == list(range(6))  # monotone, gapless
+            assert len(dones) == 1
+            done = dones[0][2]
+            # the done payload IS the buffered response body: same fields,
+            # and the streamed text deltas reassemble it exactly
+            assert "".join(e[2]["text"] for e in toks) == done["response"]
+            assert done["usage"]["completion_tokens"] == 6
+            assert serve.streams_started == 1
+            assert serve.stream_tokens_emitted == 6
+
+            # Last-Event-ID splice over the memoized replay: the SAME
+            # request id re-emits only offsets > the floor, token-identical
+            resp2 = await client.post(
+                "/chat",
+                json={
+                    "message": "hello there",
+                    "session": "s",
+                    "stream": True,
+                    "max_tokens": 6,
+                    "ignore_eos": True,
+                },
+                headers={REQUEST_ID_HEADER: "r1", LAST_EVENT_ID_HEADER: "2"},
+            )
+            assert resp2.status == 200
+            events2 = parse_sse(await resp2.content.read())
+            toks2 = [e for e in events2 if e[0] == "token"]
+            assert [e[1] for e in toks2] == [3, 4, 5]
+            assert [e[2]["token"] for e in toks2] == [e[2]["token"] for e in toks[3:]]
+            assert [e[0] for e in events2 if e[0] == "done"] == ["done"]
+        finally:
+            await client.close()
+            eng.shutdown()
+
+    run(body())
+
+
+def test_serve_stream_flag_off_stays_buffered():
+    """stream=true without the engine flag degrades to the buffered
+    JSON response — the A/B baseline is the flag, not the body."""
+    async def body():
+        eng = make_engine()  # streaming NOT enabled
+        serve = _serve_app(eng)
+        client = TestClient(TestServer(serve.app()))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/chat",
+                json={"message": "hi", "session": "s", "stream": True, "max_tokens": 4},
+            )
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("application/json")
+            doc = await resp.json()
+            assert "response" in doc and "usage" in doc
+            assert serve.streams_started == 0
+        finally:
+            await client.close()
+            eng.shutdown()
+
+    run(body())
+
+
+class _SlowStreamEngine:
+    """Duck-typed engine double: emits one token, then holds the stream
+    open until released/cancelled (heartbeat + disconnect tests)."""
+
+    streaming = True
+
+    def __init__(self, hold_s: float = 10.0):
+        self.sessions = {}
+        self.cancelled = []
+        self.hold_s = hold_s
+        self.tokenizer = SimpleNamespace(decode=lambda ids: "x" * len(ids))
+        self._release = None
+
+    async def chat(self, session, message, max_tokens=64, request_id="", emit=None, **kw):
+        self.sessions[session] = 0
+        self._release = asyncio.Event()
+        if emit:
+            emit(0, [7])
+        try:
+            await asyncio.wait_for(self._release.wait(), timeout=self.hold_s)
+        except asyncio.TimeoutError:
+            pass
+        return {
+            "text": "x",
+            "tokens": [7],
+            "prompt_tokens": 1,
+            "completion_tokens": 1,
+            "ttft_ms": 1.0,
+            "ttft_breakdown": None,
+        }
+
+    def cancel(self, request_id):
+        self.cancelled.append(request_id)
+        if self._release is not None:
+            self._release.set()
+        return True
+
+    def drain(self, budget_s):  # app cleanup calls the rolling-restart drain
+        if self._release is not None:
+            self._release.set()
+        return True
+
+    def shutdown(self):
+        if self._release is not None:
+            self._release.set()
+
+
+def test_serve_stream_heartbeats_never_advance_offsets():
+    async def body():
+        eng = _SlowStreamEngine(hold_s=0.4)
+        serve = _serve_app(eng)
+        serve.stream_heartbeat_s = 0.05
+        client = TestClient(TestServer(serve.app()))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/chat", json={"message": "hi", "session": "s", "stream": True}
+            )
+            raw = await resp.content.read()
+            assert b": keep-alive\n\n" in raw
+            events = parse_sse(raw)
+            toks = [e for e in events if e[0] == "token"]
+            # heartbeats carry no id and never advanced the offset sequence
+            assert [e[1] for e in toks] == [0]
+            assert serve.stream_heartbeats >= 2
+        finally:
+            await client.close()
+
+    run(body())
+
+
+def test_serve_stream_client_disconnect_cancels_engine():
+    async def body():
+        eng = _SlowStreamEngine(hold_s=10.0)
+        serve = _serve_app(eng)
+        serve.stream_heartbeat_s = 0.05
+        client = TestClient(TestServer(serve.app()))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/chat",
+                json={"message": "hi", "session": "s", "stream": True},
+                headers={REQUEST_ID_HEADER: "gone-1"},
+            )
+            await resp.content.read(8)  # the stream is live
+            resp.close()  # consumer vanishes mid-stream
+            for _ in range(100):
+                if eng.cancelled:
+                    break
+                await asyncio.sleep(0.02)
+            assert eng.cancelled == ["gone-1"]
+            assert serve.stream_client_disconnects == 1
+        finally:
+            await client.close()
+
+    run(body())
+
+
+def test_serve_poisoned_prefill_500_carries_typed_header():
+    """The engine.prefill failpoint must surface as PrefillFailed all the
+    way through the worker's future rejection to the serve middleware's
+    500 — the poison header is what lets the proxy dead-letter the request
+    instead of archiving the 500 as a completed response."""
+    from agentainer_tpu import faults
+
+    async def body():
+        eng = make_engine()
+        serve = _serve_app(eng)
+        client = TestClient(TestServer(serve.app()))
+        await client.start_server()
+        faults.arm_spec("engine.prefill:error=RuntimeError,count=1")
+        try:
+            resp = await client.post(
+                "/chat", json={"message": "hi", "session": "s", "max_tokens": 4}
+            )
+            assert resp.status == 500
+            assert resp.headers.get(PREFILL_POISON_HEADER) == "true"
+            # strike isolated to its request: the engine serves the next one
+            resp = await client.post(
+                "/chat", json={"message": "hi again", "session": "s", "max_tokens": 4}
+            )
+            assert resp.status == 200
+        finally:
+            faults.disarm_all()
+            await client.close()
+            eng.shutdown()
+
+    run(body())
+
+
+# -- proxy: failover splice, duplicates, gaps, disconnect, poison ---------
+def make_services(tmp_path, **feature_overrides):
+    cfg = Config()
+    cfg.auth_token = TOKEN
+    cfg.features.streaming = True
+    for k, v in feature_overrides.items():
+        setattr(cfg.features, k, v)
+    return build_services(
+        config=cfg,
+        store=MemoryStore(),
+        backend=FakeBackend(),
+        console_logs=False,
+        data_dir=str(tmp_path),
+    )
+
+
+async def client_for(services) -> TestClient:
+    client = TestClient(TestServer(services.app))
+    await client.start_server()
+    return client
+
+
+async def deploy(client, name="a", start=True):
+    resp = await client.post(
+        "/agents", json={"name": name, "model": "echo"}, headers=AUTH
+    )
+    agent = (await resp.json())["data"]
+    if start:
+        resp = await client.post(f"/agents/{agent['id']}/start", headers=AUTH)
+        assert resp.status == 200
+    return agent
+
+
+def _frame(event: str, off: int, data: dict) -> bytes:
+    return f"event: {event}\nid: {off}\ndata: {json.dumps(data)}\n\n".encode()
+
+
+_DONE_PAYLOAD = {
+    "response": "streamed",
+    "agent": "stub",
+    "model": "tiny",
+    "usage": {"prompt_tokens": 1, "completion_tokens": 6},
+    "ttft_ms": 1.0,
+}
+
+
+class StubUpstream:
+    """Scripted engine-serve double: each /chat dispatch runs the next leg
+    in the script, so one test choreographs an exact crash/failover
+    sequence. Records the splice headers each leg received and /cancel."""
+
+    def __init__(self, legs):
+        self.legs = list(legs)
+        self.calls = []
+        self.cancels = []
+
+    def app(self) -> web.Application:
+        a = web.Application()
+        a.router.add_post("/chat", self.h_chat)
+        a.router.add_post("/cancel", self.h_cancel)
+        return a
+
+    async def h_cancel(self, request):
+        body = await request.json()
+        self.cancels.append(body.get("request_id"))
+        return web.json_response({"cancelled": True})
+
+    async def h_chat(self, request):
+        idx = len(self.calls)
+        self.calls.append(
+            {
+                "floor": request.headers.get(LAST_EVENT_ID_HEADER, ""),
+                "request_id": request.headers.get(REQUEST_ID_HEADER, ""),
+            }
+        )
+        leg = self.legs[min(idx, len(self.legs) - 1)]
+        return await leg(request, idx)
+
+
+async def _start_sse(request) -> web.StreamResponse:
+    r = web.StreamResponse(
+        status=200, headers={"Content-Type": STREAM_CONTENT_TYPE}
+    )
+    await r.prepare(request)
+    return r
+
+
+def emit_then_die(last_off: int, first_off: int = 0):
+    """A leg that emits [first_off..last_off] then ends WITHOUT done —
+    the mid-stream death the failover splice must absorb."""
+
+    async def leg(request, idx):
+        r = await _start_sse(request)
+        for off in range(first_off, last_off + 1):
+            await r.write(_frame("token", off, {"offset": off, "token": 100 + off, "text": f"t{off}"}))
+        return r  # EOF, no done frame
+
+    return leg
+
+
+def resume_to_done(last_off: int, ignore_floor: int | None = None):
+    """A leg that resumes at the splice cursor (or a scripted wrong floor,
+    for the duplicate-suppression test) and finishes with done."""
+
+    async def leg(request, idx):
+        if ignore_floor is not None:
+            start = ignore_floor
+        else:
+            raw = request.headers.get(LAST_EVENT_ID_HEADER, "")
+            start = (int(raw) if raw else -1) + 1
+        r = await _start_sse(request)
+        await r.write(b": keep-alive\n\n")
+        for off in range(start, last_off + 1):
+            await r.write(_frame("token", off, {"offset": off, "token": 100 + off, "text": f"t{off}"}))
+        await r.write(_frame("done", last_off, _DONE_PAYLOAD))
+        await r.write_eof()
+        return r
+
+    return leg
+
+
+async def _stream_setup(tmp_path, legs):
+    services = make_services(tmp_path)
+    client = await client_for(services)
+    agent = await deploy(client)
+    stub = StubUpstream(legs)
+    upstream = TestServer(stub.app())
+    await upstream.start_server()
+    url = f"http://{upstream.host}:{upstream.port}"
+    services.manager.endpoint = lambda a: url
+    return services, client, agent, stub, upstream
+
+
+def test_proxy_stream_gapless_failover_splice(tmp_path):
+    async def body():
+        services, client, agent, stub, upstream = await _stream_setup(
+            tmp_path, [emit_then_die(2), resume_to_done(5)]
+        )
+        try:
+            resp = await client.post(
+                f"/agent/{agent['id']}/chat",
+                json={"message": "hi", "session": "s", "stream": True},
+            )
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith(STREAM_CONTENT_TYPE)
+            rid = resp.headers[REQUEST_ID_HEADER]
+            raw = await resp.content.read()
+            events = parse_sse(raw)
+            toks = [e for e in events if e[0] == "token"]
+            # THE invariant: one gapless, duplicate-free sequence across
+            # the mid-stream upstream death, no client reconnect needed
+            assert [e[1] for e in toks] == [0, 1, 2, 3, 4, 5]
+            assert [e[0] for e in events if e[0] == "done"] == ["done"]
+            assert b": keep-alive\n\n" in raw  # heartbeat forwarded verbatim
+            # leg 2 was spliced at exactly last_acked_offset
+            assert [c["floor"] for c in stub.calls] == ["", "2"]
+            assert stub.calls[1]["request_id"] == rid
+            # journal: cursor at the last offset, entry archived COMPLETED
+            req = services.journal.get(agent["id"], rid)
+            assert req.status == RequestStatus.COMPLETED
+            assert req.stream_offset == 5
+            assert json.loads(
+                __import__("base64").b64decode(req.response["body_b64"])
+            ) == _DONE_PAYLOAD
+        finally:
+            await upstream.close()
+            await client.close()
+
+    run(body())
+
+
+def test_proxy_stream_suppresses_duplicate_emissions(tmp_path):
+    async def body():
+        # the resumed leg misbehaves: re-emits from offset 1 instead of 3 —
+        # the journal CAS + local cursor drop the duplicates on the floor
+        services, client, agent, stub, upstream = await _stream_setup(
+            tmp_path, [emit_then_die(2), resume_to_done(5, ignore_floor=1)]
+        )
+        try:
+            resp = await client.post(
+                f"/agent/{agent['id']}/chat",
+                json={"message": "hi", "session": "s", "stream": True},
+            )
+            events = parse_sse(await resp.content.read())
+            toks = [e[1] for e in events if e[0] == "token"]
+            assert toks == [0, 1, 2, 3, 4, 5]  # each offset exactly once
+        finally:
+            await upstream.close()
+            await client.close()
+
+    run(body())
+
+
+def test_proxy_stream_offset_gap_truncates_hard(tmp_path):
+    async def body():
+        async def gap_leg(request, idx):
+            r = await _start_sse(request)
+            await r.write(_frame("token", 0, {"offset": 0, "token": 100, "text": "t0"}))
+            await r.write(_frame("token", 2, {"offset": 2, "token": 102, "text": "t2"}))
+            await r.write(_frame("done", 2, _DONE_PAYLOAD))
+            await r.write_eof()
+            return r
+
+        services, client, agent, stub, upstream = await _stream_setup(
+            tmp_path, [gap_leg]
+        )
+        try:
+            resp = await client.post(
+                f"/agent/{agent['id']}/chat",
+                json={"message": "hi", "session": "s", "stream": True},
+            )
+            rid = resp.headers[REQUEST_ID_HEADER]
+            events = parse_sse(await resp.content.read())
+            assert [e[1] for e in events if e[0] == "token"] == [0]
+            # never silently skipped: the stream truncates with an error
+            # frame and NO done — the entry is not archived as complete
+            assert [e[0] for e in events if e[0] == "done"] == []
+            assert [e[0] for e in events if e[0] == "error"] == ["error"]
+            req = services.journal.get(agent["id"], rid)
+            assert req.status != RequestStatus.COMPLETED
+            assert req.stream_offset == 0
+        finally:
+            await upstream.close()
+            await client.close()
+
+    run(body())
+
+
+def test_proxy_stream_client_disconnect_aborts_engine(tmp_path):
+    async def body():
+        async def hang_leg(request, idx):
+            r = await _start_sse(request)
+            await r.write(_frame("token", 0, {"offset": 0, "token": 100, "text": "t0"}))
+            await asyncio.sleep(10)
+            return r
+
+        services, client, agent, stub, upstream = await _stream_setup(
+            tmp_path, [hang_leg]
+        )
+        try:
+            resp = await client.post(
+                f"/agent/{agent['id']}/chat",
+                json={"message": "hi", "session": "s", "stream": True},
+            )
+            rid = resp.headers[REQUEST_ID_HEADER]
+            await resp.content.read(8)  # stream is live
+            resp.close()  # consumer hangs up mid-stream
+            for _ in range(150):
+                if stub.cancels:
+                    break
+                await asyncio.sleep(0.02)
+            assert stub.cancels == [rid]  # engine lane freed
+            req = services.journal.get(agent["id"], rid)
+            # settled aborted AT the last acked offset
+            assert req.status == RequestStatus.EXPIRED
+            assert req.stream_offset == 0
+            assert "client disconnected" in req.error
+        finally:
+            await upstream.close()
+            await client.close()
+
+    run(body())
+
+
+def test_proxy_stream_resume_reattaches_journal_entry(tmp_path):
+    async def body():
+        services, client, agent, stub, upstream = await _stream_setup(
+            tmp_path, [emit_then_die(3), resume_to_done(5)]
+        )
+        try:
+            resp = await client.post(
+                f"/agent/{agent['id']}/chat",
+                json={"message": "hi", "session": "s", "stream": True},
+            )
+            rid = resp.headers[REQUEST_ID_HEADER]
+            await resp.content.read()
+            pending_before = services.journal.stats(agent["id"])["pending"]
+            # reconnect WITH the splice pair: no new journal entry is
+            # created; the same id serves the remainder
+            resp2 = await client.post(
+                f"/agent/{agent['id']}/chat",
+                json={"message": "hi", "session": "s", "stream": True},
+                headers={LAST_EVENT_ID_HEADER: "3", REQUEST_ID_HEADER: rid},
+            )
+            assert resp2.headers[REQUEST_ID_HEADER] == rid
+            events = parse_sse(await resp2.content.read())
+            assert [e[1] for e in events if e[0] == "token"] == [4, 5]
+            assert [e[0] for e in events if e[0] == "done"] == ["done"]
+            assert services.journal.stats(agent["id"])["pending"] == pending_before
+            assert (
+                services.journal.get(agent["id"], rid).status
+                == RequestStatus.COMPLETED
+            )
+        finally:
+            await upstream.close()
+            await client.close()
+
+    run(body())
+
+
+def test_proxy_buffered_poison_header_charges_poison_accounting(tmp_path):
+    async def body():
+        async def poisoned_leg(request, idx):
+            return web.json_response(
+                {"error": "PrefillFailed: boom"},
+                status=500,
+                headers={PREFILL_POISON_HEADER: "true"},
+            )
+
+        services, client, agent, stub, upstream = await _stream_setup(
+            tmp_path, [poisoned_leg]
+        )
+        try:
+            t0 = time.monotonic()
+            resp = await client.post(
+                f"/agent/{agent['id']}/chat",
+                json={"message": "hi", "session": "s"},  # buffered path
+            )
+            assert resp.status == 500  # the caller sees the truth
+            rid = resp.headers[REQUEST_ID_HEADER]
+            req = services.journal.get(agent["id"], rid)
+            # strike one: pending for ONE fast replay retry, not archived
+            assert req.status == RequestStatus.PENDING
+            assert req.retry_count == 1
+            # the replay tick is the second strike: dead-letter, seconds
+            # not minutes — no respawn ladder, the engine is healthy
+            replayed = await services.replay.scan_once()
+            assert replayed == 1
+            dead = services.journal.get(agent["id"], rid)
+            assert dead.status == RequestStatus.FAILED
+            assert dead.error.startswith("poisoned prefill:")
+            assert time.monotonic() - t0 < 5.0
+            # requeue-able for the operator
+            assert services.journal.requeue(agent["id"], rid) is not None
+        finally:
+            await upstream.close()
+            await client.close()
+
+    run(body())
+
+
+def test_proxy_stream_flag_off_keeps_buffered_path(tmp_path):
+    async def body():
+        services = make_services(tmp_path, streaming=False)
+        client = await client_for(services)
+        try:
+            agent = await deploy(client)
+            # stream=true with features.streaming off rides the buffered
+            # path end to end (FakeBackend echo response, not SSE)
+            resp = await client.post(
+                f"/agent/{agent['id']}/chat",
+                json={"message": "hi", "session": "s", "stream": True},
+            )
+            assert resp.status == 200
+            assert not resp.headers["Content-Type"].startswith(STREAM_CONTENT_TYPE)
+        finally:
+            await client.close()
+
+    run(body())
